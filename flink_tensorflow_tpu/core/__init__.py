@@ -1,0 +1,37 @@
+from flink_tensorflow_tpu.core.environment import (
+    JobHandle,
+    JobResult,
+    StreamExecutionEnvironment,
+)
+from flink_tensorflow_tpu.core.functions import (
+    Collector,
+    FilterFunction,
+    FlatMapFunction,
+    MapFunction,
+    ProcessFunction,
+    RichFunction,
+    SinkFunction,
+    SourceFunction,
+    WindowFunction,
+)
+from flink_tensorflow_tpu.core.state import StateDescriptor
+from flink_tensorflow_tpu.core.stream import DataStream, KeyedStream, WindowedStream
+
+__all__ = [
+    "StreamExecutionEnvironment",
+    "JobHandle",
+    "JobResult",
+    "DataStream",
+    "KeyedStream",
+    "WindowedStream",
+    "MapFunction",
+    "FlatMapFunction",
+    "FilterFunction",
+    "ProcessFunction",
+    "WindowFunction",
+    "SourceFunction",
+    "SinkFunction",
+    "RichFunction",
+    "Collector",
+    "StateDescriptor",
+]

@@ -1,0 +1,119 @@
+"""Host-side record channels between operator subtasks.
+
+Equivalent of Flink's Netty credit-based shuffle (SURVEY.md §2 "Distributed
+communication backend") scoped to one host: bounded queues give backpressure;
+each downstream subtask owns one :class:`InputGate` merging the channels from
+all upstream subtasks, which is where checkpoint-barrier alignment happens.
+
+Only host objects (numpy buffers, metadata) cross channels.  Device arrays
+stay in HBM inside the model operators — moving ``jax.Array``s through the
+record plane would serialize HBM traffic through the host and throw away the
+zero-copy design (BASELINE.json:4).
+
+A native C++ ring-buffer backend can replace :class:`QueueChannel` without
+touching the gate protocol (see native/ — SURVEY.md §2 notes the reference's
+only native component is the external TF core; ours is the channel layer).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import typing
+
+from flink_tensorflow_tpu.core import elements as el
+
+_POLL_INTERVAL_S = 0.05
+
+
+class InputGate:
+    """Merged input for one subtask: N channels + barrier alignment.
+
+    Writers push ``(channel_idx, element)`` into a shared bounded queue.
+    Per-channel FIFO order is preserved because each writer is a single
+    thread.  During barrier alignment, elements from already-barriered
+    channels are stashed and replayed after the checkpoint completes —
+    Flink's aligned exactly-once protocol (SURVEY.md §5).
+    """
+
+    def __init__(self, num_channels: int, capacity: int = 1024):
+        self.num_channels = num_channels
+        self._queue: "queue.Queue[typing.Tuple[int, el.StreamElement]]" = queue.Queue(
+            maxsize=capacity
+        )
+        self._stashed: typing.List[typing.Deque[typing.Tuple[int, el.StreamElement]]] = [
+            collections.deque() for _ in range(num_channels)
+        ]
+        self._replay: typing.Deque[typing.Tuple[int, el.StreamElement]] = collections.deque()
+        self._blocked: typing.List[bool] = [False] * num_channels
+        self._closed = threading.Event()
+
+    # -- writer side ---------------------------------------------------
+    def put(self, channel_idx: int, element: el.StreamElement) -> None:
+        while not self._closed.is_set():
+            try:
+                self._queue.put((channel_idx, element), timeout=_POLL_INTERVAL_S)
+                return
+            except queue.Full:
+                continue
+        # Gate torn down (job cancelled/finished): drop silently.
+
+    # -- reader side (single consumer thread) --------------------------
+    def poll(self, timeout: typing.Optional[float] = None) -> typing.Optional[typing.Tuple[int, el.StreamElement]]:
+        """Next (channel, element) honoring blocked channels; None on timeout."""
+        while self._replay:
+            idx, element = self._replay.popleft()
+            if self._blocked[idx]:
+                self._stashed[idx].append((idx, element))
+                continue
+            return idx, element
+        deadline = None if timeout is None else (_now() + timeout)
+        while True:
+            remaining = None if deadline is None else max(0.0, deadline - _now())
+            try:
+                idx, element = self._queue.get(timeout=remaining if remaining is not None else _POLL_INTERVAL_S)
+            except queue.Empty:
+                if deadline is not None and _now() >= deadline:
+                    return None
+                continue
+            if self._blocked[idx]:
+                self._stashed[idx].append((idx, element))
+                continue
+            return idx, element
+
+    def block_channel(self, idx: int) -> None:
+        self._blocked[idx] = True
+
+    def unblock_all(self) -> None:
+        self._blocked = [False] * self.num_channels
+        stashed = self._stashed
+        self._stashed = [collections.deque() for _ in range(self.num_channels)]
+        for dq in stashed:
+            self._replay.extend(dq)
+
+    def close(self) -> None:
+        self._closed.set()
+
+    @property
+    def any_blocked(self) -> bool:
+        return any(self._blocked)
+
+
+def _now() -> float:
+    import time
+
+    return time.monotonic()
+
+
+class ChannelWriter:
+    """Upstream handle to one channel of a downstream gate."""
+
+    __slots__ = ("_gate", "_idx")
+
+    def __init__(self, gate: InputGate, idx: int):
+        self._gate = gate
+        self._idx = idx
+
+    def write(self, element: el.StreamElement) -> None:
+        self._gate.put(self._idx, element)

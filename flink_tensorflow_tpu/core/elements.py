@@ -1,0 +1,52 @@
+"""Stream elements — the wire protocol between operator subtasks.
+
+The reference (flink-tensorflow on Apache Flink) inherits Flink's
+``StreamElement`` hierarchy: records, watermarks, checkpoint barriers and
+end-of-partition events flow through the same channels (SURVEY.md §1 L1).
+This module is the TPU-native framework's equivalent: plain Python objects
+on the host-side record plane.  Device data never flows through channels —
+records carry host buffers (numpy) or references, and only the model
+operators move them to HBM (see flink_tensorflow_tpu.tensors.marshal).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+MAX_WATERMARK = float("inf")
+
+
+@dataclasses.dataclass(slots=True)
+class StreamRecord:
+    """A data record with an optional event-time timestamp."""
+
+    value: typing.Any
+    timestamp: typing.Optional[float] = None
+
+
+@dataclasses.dataclass(slots=True, frozen=True)
+class Watermark:
+    """Event-time watermark: no records with ts <= ``timestamp`` will follow."""
+
+    timestamp: float
+
+
+@dataclasses.dataclass(slots=True, frozen=True)
+class CheckpointBarrier:
+    """Chandy-Lamport snapshot barrier (Flink-style aligned checkpointing).
+
+    Injected at sources by the checkpoint coordinator; operators align
+    barriers across their input channels, snapshot state, then forward the
+    barrier downstream (SURVEY.md §5 "Checkpoint / resume").
+    """
+
+    checkpoint_id: int
+
+
+@dataclasses.dataclass(slots=True, frozen=True)
+class EndOfPartition:
+    """Sent once per output channel when an upstream subtask finishes."""
+
+
+StreamElement = typing.Union[StreamRecord, Watermark, CheckpointBarrier, EndOfPartition]

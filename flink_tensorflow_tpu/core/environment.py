@@ -1,0 +1,141 @@
+"""StreamExecutionEnvironment — job construction and execution entry point.
+
+Equivalent of Flink's ``StreamExecutionEnvironment`` (SURVEY.md §3.1: the
+user job builds a graph, ``execute()`` ships it to the runtime).  The local
+executor replaces the JobManager/TaskManager cluster for one host; the same
+graph runs per host in the multi-host deployment with jax.distributed
+providing the global device mesh (flink_tensorflow_tpu.parallel.multihost).
+"""
+
+from __future__ import annotations
+
+import typing
+
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.graph import DataflowGraph
+from flink_tensorflow_tpu.core.operators import SourceOperator
+from flink_tensorflow_tpu.core.runtime import LocalExecutor
+from flink_tensorflow_tpu.core.stream import DataStream
+from flink_tensorflow_tpu.io.sources import CollectionSource
+from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+
+class JobResult:
+    def __init__(self, metrics: typing.Dict[str, typing.Any]):
+        self.metrics = metrics
+
+
+class JobHandle:
+    """Handle to an asynchronously running job."""
+
+    def __init__(self, executor: LocalExecutor):
+        self.executor = executor
+
+    def trigger_checkpoint(self, timeout: float = 60.0):
+        """Run one aligned checkpoint; returns the snapshot mapping."""
+        return self.executor.coordinator.trigger(timeout=timeout)
+
+    def wait(self, timeout: typing.Optional[float] = None) -> JobResult:
+        self.executor.join(timeout)
+        return JobResult(self.executor.metrics.report())
+
+    def cancel(self) -> None:
+        self.executor.cancel()
+
+    @property
+    def metrics(self) -> MetricRegistry:
+        return self.executor.metrics
+
+
+class StreamExecutionEnvironment:
+    def __init__(self, parallelism: int = 1):
+        self.graph = DataflowGraph()
+        self.default_parallelism = parallelism
+        self.checkpoint_dir: typing.Optional[str] = None
+        self.channel_capacity = 1024
+        self.device_provider: typing.Optional[typing.Callable[[str, int], typing.Any]] = None
+        self.mesh: typing.Optional[typing.Any] = None
+        self.job_config: typing.Dict[str, typing.Any] = {}
+        self.source_throttle_s = 0.0
+        self.metric_registry = MetricRegistry()
+
+    # -- configuration ----------------------------------------------------
+    def set_parallelism(self, parallelism: int) -> "StreamExecutionEnvironment":
+        self.default_parallelism = parallelism
+        return self
+
+    def enable_checkpointing(self, checkpoint_dir: str) -> "StreamExecutionEnvironment":
+        self.checkpoint_dir = checkpoint_dir
+        return self
+
+    def set_device_provider(
+        self, provider: typing.Callable[[str, int], typing.Any]
+    ) -> "StreamExecutionEnvironment":
+        """Assign a jax device per (task_name, subtask_index) — operator DP."""
+        self.device_provider = provider
+        return self
+
+    def set_mesh(self, mesh) -> "StreamExecutionEnvironment":
+        """Share a jax.sharding.Mesh with gang operators (DP/TP training)."""
+        self.mesh = mesh
+        return self
+
+    # -- sources ----------------------------------------------------------
+    def from_collection(
+        self, data: typing.Sequence[typing.Any], *, name="collection", parallelism: int = 1
+    ) -> DataStream:
+        return self.from_source(CollectionSource(data), name=name, parallelism=parallelism)
+
+    def from_source(
+        self, source: fn.SourceFunction, *, name="source", parallelism: int = 1
+    ) -> DataStream:
+        t = self.graph.add(
+            name,
+            lambda: SourceOperator(name, source),
+            parallelism,
+            is_source=True,
+        )
+        return DataStream(self, t)
+
+    # -- execution ---------------------------------------------------------
+    def _make_executor(self) -> LocalExecutor:
+        return LocalExecutor(
+            self.graph,
+            channel_capacity=self.channel_capacity,
+            metric_registry=self.metric_registry,
+            device_provider=self.device_provider,
+            mesh=self.mesh,
+            job_config=self.job_config,
+            source_throttle_s=self.source_throttle_s,
+            checkpoint_dir=self.checkpoint_dir,
+        )
+
+    def execute(
+        self,
+        job_name: str = "job",
+        *,
+        timeout: typing.Optional[float] = None,
+        restore_from: typing.Optional[str] = None,
+        restore_checkpoint_id: typing.Optional[int] = None,
+    ) -> JobResult:
+        """Run the job to completion on the local executor."""
+        handle = self.execute_async(
+            job_name, restore_from=restore_from, restore_checkpoint_id=restore_checkpoint_id
+        )
+        return handle.wait(timeout)
+
+    def execute_async(
+        self,
+        job_name: str = "job",
+        *,
+        restore_from: typing.Optional[str] = None,
+        restore_checkpoint_id: typing.Optional[int] = None,
+    ) -> JobHandle:
+        executor = self._make_executor()
+        if restore_from is not None:
+            from flink_tensorflow_tpu.checkpoint.store import read_checkpoint
+
+            _, snapshots = read_checkpoint(restore_from, restore_checkpoint_id)
+            executor.restore(snapshots)
+        executor.start()
+        return JobHandle(executor)

@@ -1,0 +1,139 @@
+"""User-function interfaces for the streaming layer.
+
+Equivalent of Flink's function SPI (``MapFunction``/``ProcessFunction``/
+``RichFunction`` lifecycle) that the reference's ``ModelFunction`` plugs into
+(SURVEY.md §1 L4, BASELINE.json:4).  Rich lifecycle matters here for the same
+reason it does in the reference: ``open()`` is where a model operator loads
+and XLA-compiles its model (reference: builds TF Graph + Session), ``close()``
+is where device buffers are released.
+"""
+
+from __future__ import annotations
+
+import abc
+import typing
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
+
+
+class Function:
+    """Base of all user functions (marker)."""
+
+    def clone(self) -> "Function":
+        """Per-subtask copy (Flink ships a serialized copy to each subtask).
+
+        Default is a deepcopy so subtasks never share mutable state; override
+        to share intentionally (e.g. collecting sinks) or to avoid copying
+        heavyweight members that ``open()`` will build anyway.
+        """
+        import copy
+
+        return copy.deepcopy(self)
+
+
+class RichFunction(Function):
+    """Function with a managed lifecycle and access to runtime context."""
+
+    def open(self, ctx: "RuntimeContext") -> None:  # noqa: B027
+        """Called once per subtask before any element is processed."""
+
+    def close(self) -> None:  # noqa: B027
+        """Called once per subtask after the last element (or on cancel)."""
+
+    # --- optional state hooks (participate in snapshots) -------------
+    def snapshot_state(self) -> typing.Any:  # noqa: B027
+        """Return a picklable snapshot of operator state (or None)."""
+        return None
+
+    def restore_state(self, state: typing.Any) -> None:  # noqa: B027
+        """Restore from a snapshot produced by :meth:`snapshot_state`."""
+
+
+class MapFunction(RichFunction, abc.ABC):
+    @abc.abstractmethod
+    def map(self, value: typing.Any) -> typing.Any: ...
+
+
+class FlatMapFunction(RichFunction, abc.ABC):
+    @abc.abstractmethod
+    def flat_map(self, value: typing.Any) -> typing.Iterable[typing.Any]: ...
+
+
+class FilterFunction(RichFunction, abc.ABC):
+    @abc.abstractmethod
+    def filter(self, value: typing.Any) -> bool: ...
+
+
+class Collector:
+    """Downstream emitter handed to process-style functions."""
+
+    __slots__ = ("_emit",)
+
+    def __init__(self, emit: typing.Callable[[typing.Any, typing.Optional[float]], None]):
+        self._emit = emit
+
+    def collect(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
+        self._emit(value, timestamp)
+
+
+class ProcessFunction(RichFunction, abc.ABC):
+    """Low-level per-record function with a collector (non-keyed or keyed)."""
+
+    @abc.abstractmethod
+    def process_element(self, value: typing.Any, ctx: "ProcessContext", out: Collector) -> None: ...
+
+    def on_timer(self, timestamp: float, ctx: "ProcessContext", out: Collector) -> None:  # noqa: B027
+        """Called when a registered processing-time timer fires."""
+
+
+class ProcessContext:
+    """Per-element context: timestamp, current key, timers, keyed state."""
+
+    __slots__ = ("timestamp", "current_key", "_runtime")
+
+    def __init__(self, runtime):
+        self.timestamp: typing.Optional[float] = None
+        self.current_key: typing.Any = None
+        self._runtime = runtime
+
+    def state(self, descriptor):
+        """Keyed state access (scoped to :attr:`current_key`)."""
+        return self._runtime.get_value_state(descriptor)
+
+    def register_timer(self, timestamp: float) -> None:
+        self._runtime.register_timer(self.current_key, timestamp)
+
+
+class WindowFunction(RichFunction, abc.ABC):
+    """Invoked with the full contents of a fired window (micro-batch hook).
+
+    This is the slot the reference's windowed micro-batch inference occupies
+    (BASELINE.json:7 — "windowed ProcessFunction, count-window micro-batch").
+    """
+
+    @abc.abstractmethod
+    def process_window(
+        self,
+        key: typing.Any,
+        window: typing.Any,
+        elements: typing.Sequence[typing.Any],
+        out: Collector,
+    ) -> None: ...
+
+
+class SourceFunction(RichFunction, abc.ABC):
+    """Pull-based source: yields values; offset tracking enables replay."""
+
+    @abc.abstractmethod
+    def run(self) -> typing.Iterator[typing.Any]: ...
+
+
+class SinkFunction(RichFunction, abc.ABC):
+    @abc.abstractmethod
+    def invoke(self, value: typing.Any) -> None: ...
+
+
+class ReduceFunction(RichFunction, abc.ABC):
+    @abc.abstractmethod
+    def reduce(self, acc: typing.Any, value: typing.Any) -> typing.Any: ...

@@ -1,0 +1,92 @@
+"""Logical dataflow graph (the JobGraph equivalent).
+
+The reference delegates this entirely to Flink's StreamGraph/JobGraph
+translation (SURVEY.md §1 L1).  Here transformations record an operator
+factory + parallelism + input edges; the runtime instantiates one operator
+per subtask and wires channels per partitioner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+from flink_tensorflow_tpu.core.partitioning import Partitioner
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.operators import Operator
+
+
+@dataclasses.dataclass
+class Edge:
+    upstream: "Transformation"
+    partitioner: Partitioner
+
+
+@dataclasses.dataclass
+class Transformation:
+    """One logical operator in the dataflow graph."""
+
+    id: int
+    name: str
+    operator_factory: typing.Callable[[], "Operator"]
+    parallelism: int
+    inputs: typing.List[Edge] = dataclasses.field(default_factory=list)
+    is_source: bool = False
+
+    def __hash__(self) -> int:
+        return self.id
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Transformation) and other.id == self.id
+
+
+class DataflowGraph:
+    def __init__(self) -> None:
+        self.transformations: typing.List[Transformation] = []
+        self._next_id = 0
+
+    def add(
+        self,
+        name: str,
+        operator_factory: typing.Callable[[], "Operator"],
+        parallelism: int,
+        inputs: typing.Optional[typing.List[Edge]] = None,
+        is_source: bool = False,
+    ) -> Transformation:
+        if parallelism <= 0:
+            raise ValueError(f"parallelism must be positive, got {parallelism}")
+        t = Transformation(
+            id=self._next_id,
+            name=name,
+            operator_factory=operator_factory,
+            parallelism=parallelism,
+            inputs=list(inputs or []),
+            is_source=is_source,
+        )
+        self._next_id += 1
+        self.transformations.append(t)
+        return t
+
+    def topological_order(self) -> typing.List[Transformation]:
+        order: typing.List[Transformation] = []
+        visited: typing.Set[int] = set()
+
+        def visit(t: Transformation) -> None:
+            if t.id in visited:
+                return
+            visited.add(t.id)
+            for edge in t.inputs:
+                visit(edge.upstream)
+            order.append(t)
+
+        for t in self.transformations:
+            visit(t)
+        return order
+
+    def downstream_of(self, t: Transformation) -> typing.List[Transformation]:
+        return [
+            other
+            for other in self.transformations
+            if any(e.upstream.id == t.id for e in other.inputs)
+        ]

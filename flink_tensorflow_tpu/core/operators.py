@@ -1,0 +1,340 @@
+"""Runtime operators — the physical counterparts of logical transformations.
+
+Equivalent of Flink's ``StreamOperator`` layer that hosts the reference's
+``ModelFunction`` (SURVEY.md §1 L4/L5).  Each operator instance runs on
+exactly one subtask thread (single-writer contract, SURVEY.md §5), processes
+stream elements, and participates in the snapshot protocol.
+
+Design note (TPU-first): operators are *host-side* control code.  Anything
+numeric happens inside user functions via jitted callables on device; the
+operator layer never inspects tensor contents, so Python overhead stays off
+the per-FLOP path — one operator invocation per *batch*, not per scalar.
+"""
+
+from __future__ import annotations
+
+import time
+import typing
+
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.state import KeyedStateStore
+from flink_tensorflow_tpu.core.windows import Trigger, WindowBuffer
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
+
+
+class Output:
+    """Downstream emitter for one subtask; routes via edge partitioners."""
+
+    def __init__(self, edges):
+        # edges: list of (partitioner, [ChannelWriter per downstream subtask])
+        self._edges = edges
+
+    def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
+        record = el.StreamRecord(value, timestamp)
+        for partitioner, writers in self._edges:
+            for idx in partitioner.select(value, len(writers)):
+                writers[idx].write(record)
+
+    def broadcast_element(self, element: el.StreamElement) -> None:
+        """Barriers / watermarks / EOP go to every downstream channel."""
+        for _, writers in self._edges:
+            for w in writers:
+                w.write(element)
+
+    @property
+    def has_downstream(self) -> bool:
+        return bool(self._edges)
+
+
+class Operator:
+    """Base runtime operator."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ctx: typing.Optional["RuntimeContext"] = None
+        self.output: typing.Optional[Output] = None
+        self.keyed_state: typing.Optional[KeyedStateStore] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def setup(self, ctx: "RuntimeContext", output: Output, keyed_state: KeyedStateStore) -> None:
+        self.ctx = ctx
+        self.output = output
+        self.keyed_state = keyed_state
+
+    def open(self) -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+    # -- element processing -------------------------------------------
+    def process_record(self, record: el.StreamRecord) -> None:
+        raise NotImplementedError
+
+    def process_watermark(self, watermark: el.Watermark) -> None:
+        self.output.broadcast_element(watermark)
+
+    def finish(self) -> None:  # noqa: B027
+        """End of input: flush any buffered elements (e.g. open windows)."""
+
+    # -- timers (adaptive batching) -------------------------------------
+    def next_deadline(self) -> typing.Optional[float]:
+        """Earliest monotonic time this operator must be poked, or None."""
+        return None
+
+    def fire_due(self, now: float) -> None:  # noqa: B027
+        """Called by the subtask loop when ``next_deadline`` has passed."""
+
+    # -- snapshot protocol ----------------------------------------------
+    def snapshot(self) -> typing.Dict[str, typing.Any]:
+        return {
+            "keyed": self.keyed_state.snapshot(),
+            "function": self._function_snapshot(),
+            "operator": self._operator_snapshot(),
+        }
+
+    def restore(self, snap: typing.Dict[str, typing.Any]) -> None:
+        self.keyed_state.restore(snap["keyed"])
+        self._function_restore(snap["function"])
+        self._operator_restore(snap["operator"])
+
+    def _function_snapshot(self) -> typing.Any:
+        return None
+
+    def _function_restore(self, state: typing.Any) -> None:
+        pass
+
+    def _operator_snapshot(self) -> typing.Any:
+        return None
+
+    def _operator_restore(self, state: typing.Any) -> None:
+        pass
+
+
+class _FunctionOperator(Operator):
+    """Operator wrapping one rich user function."""
+
+    def __init__(self, name: str, function: fn.Function):
+        super().__init__(name)
+        self.function = function.clone()
+
+    def open(self) -> None:
+        if isinstance(self.function, fn.RichFunction):
+            self.function.open(self.ctx)
+
+    def close(self) -> None:
+        if isinstance(self.function, fn.RichFunction):
+            self.function.close()
+
+    def _function_snapshot(self):
+        if isinstance(self.function, fn.RichFunction):
+            return self.function.snapshot_state()
+        return None
+
+    def _function_restore(self, state):
+        if state is not None and isinstance(self.function, fn.RichFunction):
+            self.function.restore_state(state)
+
+
+class MapOperator(_FunctionOperator):
+    def process_record(self, record):
+        self.output.emit(self.function.map(record.value), record.timestamp)
+
+
+class FlatMapOperator(_FunctionOperator):
+    def process_record(self, record):
+        for out in self.function.flat_map(record.value):
+            self.output.emit(out, record.timestamp)
+
+
+class FilterOperator(_FunctionOperator):
+    def process_record(self, record):
+        if self.function.filter(record.value):
+            self.output.emit(record.value, record.timestamp)
+
+
+class ProcessOperator(_FunctionOperator):
+    """Hosts a ProcessFunction; keyed if ``key_selector`` is set."""
+
+    def __init__(self, name, function, key_selector=None):
+        super().__init__(name, function)
+        self.key_selector = key_selector
+        self._collector: typing.Optional[fn.Collector] = None
+        self._pctx: typing.Optional[fn.ProcessContext] = None
+        self._timers: typing.Dict[typing.Tuple[typing.Any, float], None] = {}
+
+    def open(self) -> None:
+        self._collector = fn.Collector(self.output.emit)
+        self._pctx = fn.ProcessContext(self)
+        super().open()
+
+    # ProcessContext runtime hooks -------------------------------------
+    def get_value_state(self, descriptor):
+        return self.keyed_state.value_state(descriptor)
+
+    def register_timer(self, key, timestamp: float) -> None:
+        self._timers[(key, timestamp)] = None
+
+    def process_record(self, record):
+        if self.key_selector is not None:
+            key = self.key_selector(record.value)
+            self.keyed_state.current_key = key
+            self._pctx.current_key = key
+        self._pctx.timestamp = record.timestamp
+        self.function.process_element(record.value, self._pctx, self._collector)
+
+    def next_deadline(self):
+        if not self._timers:
+            return None
+        return min(ts for (_, ts) in self._timers)
+
+    def fire_due(self, now):
+        due = [(k, ts) for (k, ts) in self._timers if ts <= now]
+        for key, ts in sorted(due, key=lambda x: x[1]):
+            del self._timers[(key, ts)]
+            self.keyed_state.current_key = key
+            self._pctx.current_key = key
+            self._pctx.timestamp = ts
+            self.function.on_timer(ts, self._pctx, self._collector)
+
+    def _operator_snapshot(self):
+        return {"timers": list(self._timers.keys())}
+
+    def _operator_restore(self, state):
+        self._timers = {tuple(t): None for t in state["timers"]}
+
+
+class WindowOperator(_FunctionOperator):
+    """Count/timeout windows per key (or per subtask when non-keyed).
+
+    This operator IS the micro-batcher: a fired window hands its elements
+    to a WindowFunction in one call — the TPU path's single jitted
+    ``[B, ...]`` invocation (SURVEY.md §3.2).
+    """
+
+    GLOBAL_KEY = "__subtask__"
+
+    def __init__(self, name, function: fn.WindowFunction, trigger: Trigger, key_selector=None):
+        super().__init__(name, function)
+        self.trigger = trigger
+        self.key_selector = key_selector
+        self._buffers: typing.Dict[typing.Any, WindowBuffer] = {}
+        self._window_seq: typing.Dict[typing.Any, int] = {}
+        self._collector: typing.Optional[fn.Collector] = None
+
+    def open(self) -> None:
+        self._collector = fn.Collector(self.output.emit)
+        super().open()
+
+    def _key_of(self, value):
+        return self.key_selector(value) if self.key_selector is not None else self.GLOBAL_KEY
+
+    def process_record(self, record):
+        key = self._key_of(record.value)
+        buf = self._buffers.get(key)
+        if buf is None:
+            from flink_tensorflow_tpu.core.windows import CountWindow
+
+            seq = self._window_seq.get(key, 0)
+            buf = WindowBuffer(window=CountWindow(seq))
+            self._buffers[key] = buf
+        buf.add(record.value, record.timestamp)
+        if self.trigger.on_element(buf):
+            self._fire(key, buf)
+
+    def _fire(self, key, buf: WindowBuffer) -> None:
+        del self._buffers[key]
+        self._window_seq[key] = self._window_seq.get(key, 0) + 1
+        if self.key_selector is not None:
+            self.keyed_state.current_key = key
+        self.function.process_window(
+            key if self.key_selector is not None else None,
+            buf.window,
+            buf.elements,
+            self._collector,
+        )
+
+    def next_deadline(self):
+        deadlines = [
+            d for d in (self.trigger.deadline(buf) for buf in self._buffers.values()) if d is not None
+        ]
+        return min(deadlines) if deadlines else None
+
+    def fire_due(self, now):
+        due = [
+            key
+            for key, buf in self._buffers.items()
+            if (d := self.trigger.deadline(buf)) is not None and d <= now
+        ]
+        for key in due:
+            self._fire(key, self._buffers[key])
+
+    def finish(self):
+        for key in list(self._buffers.keys()):
+            self._fire(key, self._buffers[key])
+
+    def _operator_snapshot(self):
+        return {
+            "buffers": {
+                key: (buf.window, list(buf.elements), list(buf.timestamps))
+                for key, buf in self._buffers.items()
+            },
+            "seq": dict(self._window_seq),
+        }
+
+    def _operator_restore(self, state):
+        self._buffers = {}
+        for key, (window, elements, timestamps) in state["buffers"].items():
+            buf = WindowBuffer(window=window)
+            buf.elements = list(elements)
+            buf.timestamps = list(timestamps)
+            buf.first_element_time = time.monotonic()
+            self._buffers[key] = buf
+        self._window_seq = dict(state["seq"])
+
+
+class SinkOperator(_FunctionOperator):
+    def process_record(self, record):
+        self.function.invoke(record.value)
+
+    def process_watermark(self, watermark):
+        pass  # terminal
+
+
+class SourceOperator(_FunctionOperator):
+    """Replayable source: tracks an offset, skips on restore.
+
+    Mirrors Flink's source-with-offset contract that makes the aligned
+    snapshots exactly-once end to end (SURVEY.md §5 "Checkpoint / resume").
+    """
+
+    def __init__(self, name, function: fn.SourceFunction):
+        super().__init__(name, function)
+        self.offset = 0
+        self._restored_offset = 0
+
+    def iterate(self) -> typing.Iterator[typing.Any]:
+        """Yields values; the caller must call :meth:`record_emitted` after
+        each downstream emit so a barrier between yield and emit never
+        counts the in-flight record as already emitted."""
+        it = self.function.run()
+        # Replay: skip records already emitted before the restored snapshot.
+        for _ in range(self._restored_offset):
+            next(it, None)
+        self.offset = self._restored_offset
+        yield from it
+
+    def record_emitted(self) -> None:
+        self.offset += 1
+
+    def process_record(self, record):  # pragma: no cover - sources have no input
+        raise RuntimeError("SourceOperator has no input")
+
+    def _operator_snapshot(self):
+        return {"offset": self.offset}
+
+    def _operator_restore(self, state):
+        self._restored_offset = state["offset"]

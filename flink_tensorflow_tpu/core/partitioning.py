@@ -1,0 +1,78 @@
+"""Stream partitioners — how records route between operator subtasks.
+
+Equivalent of Flink's ``StreamPartitioner`` family used by the reference's
+record plane (SURVEY.md §2 "Distributed communication backend": Flink's
+Netty shuffle is the record plane; gradients ride a separate NCCL plane).
+Here the record plane is host-side channels; the gradient plane is XLA
+collectives over ICI and never appears as a partitioner at all.
+"""
+
+from __future__ import annotations
+
+import abc
+import typing
+
+import numpy as np
+
+
+def _stable_hash(key: typing.Any) -> int:
+    """Deterministic across processes (unlike ``hash`` with PYTHONHASHSEED)."""
+    if isinstance(key, (int, np.integer)):
+        return int(key) & 0x7FFFFFFFFFFFFFFF
+    if isinstance(key, bytes):
+        data = key
+    else:
+        data = repr(key).encode("utf-8")
+    # FNV-1a 64-bit
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h & 0x7FFFFFFFFFFFFFFF
+
+
+class Partitioner(abc.ABC):
+    """Selects target downstream channel(s) for one record."""
+
+    @abc.abstractmethod
+    def select(self, value: typing.Any, num_channels: int) -> typing.Sequence[int]: ...
+
+    def is_broadcast(self) -> bool:
+        return False
+
+
+class ForwardPartitioner(Partitioner):
+    """1:1 — requires equal upstream/downstream parallelism."""
+
+    def select(self, value, num_channels):
+        return (0,)
+
+
+class RebalancePartitioner(Partitioner):
+    """Round-robin across downstream subtasks (stateful per upstream)."""
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def select(self, value, num_channels):
+        idx = self._next % num_channels
+        self._next = idx + 1
+        return (idx,)
+
+
+class HashPartitioner(Partitioner):
+    """Key-hash routing; same key always reaches the same subtask."""
+
+    def __init__(self, key_selector: typing.Callable[[typing.Any], typing.Any]):
+        self.key_selector = key_selector
+
+    def select(self, value, num_channels):
+        return (_stable_hash(self.key_selector(value)) % num_channels,)
+
+
+class BroadcastPartitioner(Partitioner):
+    def select(self, value, num_channels):
+        return tuple(range(num_channels))
+
+    def is_broadcast(self) -> bool:
+        return True

@@ -1,0 +1,343 @@
+"""Local multi-subtask executor — the TaskManager equivalent.
+
+The reference runs on Flink's JobManager/TaskManager cluster (SURVEY.md §1
+L1); jobs are threads-in-one-process here, one thread per operator subtask
+(the reference's "task slot").  Threads, not asyncio, because the hot path
+blocks in XLA device execution which releases the GIL — a subtask spending
+its time inside ``jax.jit``-compiled calls runs truly parallel to the others.
+
+The mapping to TPU topology (SURVEY.md §7 step 4): subtask index -> local
+chip for operator-DP inference; gang operators instead share one
+``jax.sharding.Mesh`` spanning all chips (DP training).  Multi-host
+execution re-uses this executor per host with jax.distributed providing the
+global mesh (see flink_tensorflow_tpu.parallel.multihost).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import typing
+
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core.channels import ChannelWriter, InputGate
+from flink_tensorflow_tpu.core.graph import DataflowGraph, Transformation
+from flink_tensorflow_tpu.core.operators import Operator, Output, SourceOperator
+from flink_tensorflow_tpu.core.partitioning import ForwardPartitioner
+from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
+from flink_tensorflow_tpu.core.state import KeyedStateStore
+from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+logger = logging.getLogger(__name__)
+
+_IDLE_POLL_S = 0.05
+
+
+class JobFailure(RuntimeError):
+    pass
+
+
+class _Subtask:
+    def __init__(
+        self,
+        executor: "LocalExecutor",
+        transformation: Transformation,
+        index: int,
+        operator: Operator,
+        gate: typing.Optional[InputGate],
+        num_input_channels: int,
+    ):
+        self.executor = executor
+        self.t = transformation
+        self.index = index
+        self.operator = operator
+        self.gate = gate
+        self.num_input_channels = num_input_channels
+        self.output: typing.Optional[Output] = None
+        self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
+        self._control_lock = threading.Lock()
+        self.thread: typing.Optional[threading.Thread] = None
+        self.finished = threading.Event()
+
+    @property
+    def scope(self) -> str:
+        return f"{self.t.name}.{self.index}"
+
+    # --- source control -------------------------------------------------
+    def request_checkpoint(self, checkpoint_id: int) -> None:
+        with self._control_lock:
+            self.control.append(checkpoint_id)
+
+    def _drain_control(self) -> typing.List[int]:
+        with self._control_lock:
+            pending, self.control = self.control, []
+        return pending
+
+    # --- thread bodies ---------------------------------------------------
+    def run_source(self) -> None:
+        op = typing.cast(SourceOperator, self.operator)
+        try:
+            op.open()
+            throttle = self.executor.source_throttle_s
+            for value in op.iterate():
+                if self.executor.cancelled.is_set():
+                    break
+                for cid in self._drain_control():
+                    self._snapshot_and_ack(cid)
+                    self.output.broadcast_element(el.CheckpointBarrier(cid))
+                self.output.emit(value)
+                op.record_emitted()
+                if throttle:
+                    time.sleep(throttle)
+            # Serve any barrier requests that raced with the last records.
+            for cid in self._drain_control():
+                self._snapshot_and_ack(cid)
+                self.output.broadcast_element(el.CheckpointBarrier(cid))
+            op.finish()
+            self.output.broadcast_element(el.EndOfPartition())
+            op.close()
+        except BaseException as exc:  # noqa: BLE001
+            self.executor.fail(self, exc)
+        finally:
+            self.finished.set()
+            self.executor.subtask_finished(self)
+
+    def run_worker(self) -> None:
+        op = self.operator
+        gate = self.gate
+        n = self.num_input_channels
+        eop = [False] * n
+        barrier_seen: typing.Dict[int, typing.Set[int]] = {}
+        watermarks = [float("-inf")] * n
+        current_wm = float("-inf")
+        try:
+            op.open()
+            active = n
+            while active > 0 and not self.executor.cancelled.is_set():
+                deadline = op.next_deadline()
+                now = time.monotonic()
+                timeout = _IDLE_POLL_S if deadline is None else max(0.0, min(deadline - now, _IDLE_POLL_S))
+                item = gate.poll(timeout=timeout)
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    op.fire_due(now)
+                if item is None:
+                    continue
+                idx, element = item
+                if isinstance(element, el.StreamRecord):
+                    op.process_record(element)
+                elif isinstance(element, el.CheckpointBarrier):
+                    cid = element.checkpoint_id
+                    seen = barrier_seen.setdefault(cid, set())
+                    seen.add(idx)
+                    gate.block_channel(idx)
+                    live = {i for i in range(n) if not eop[i]}
+                    if live <= seen:
+                        self._snapshot_and_ack(cid)
+                        self.output.broadcast_element(element)
+                        del barrier_seen[cid]
+                        gate.unblock_all()
+                elif isinstance(element, el.Watermark):
+                    watermarks[idx] = element.timestamp
+                    new_wm = min(
+                        watermarks[i] for i in range(n) if not eop[i]
+                    )
+                    if new_wm > current_wm:
+                        current_wm = new_wm
+                        op.process_watermark(el.Watermark(current_wm))
+                elif isinstance(element, el.EndOfPartition):
+                    eop[idx] = True
+                    active -= 1
+                    # A finished channel counts as barriered for all pending
+                    # alignments (it can never deliver its barrier).
+                    for cid, seen in list(barrier_seen.items()):
+                        live = {i for i in range(n) if not eop[i]}
+                        if live and live <= seen:
+                            self._snapshot_and_ack(cid)
+                            self.output.broadcast_element(el.CheckpointBarrier(cid))
+                            del barrier_seen[cid]
+                            gate.unblock_all()
+            if not self.executor.cancelled.is_set():
+                op.finish()
+                self.output.broadcast_element(el.EndOfPartition())
+            op.close()
+        except BaseException as exc:  # noqa: BLE001
+            self.executor.fail(self, exc)
+        finally:
+            self.finished.set()
+            self.executor.subtask_finished(self)
+
+    def _snapshot_and_ack(self, checkpoint_id: int) -> None:
+        snapshot = self.operator.snapshot()
+        self.executor.coordinator.ack(checkpoint_id, self.t.name, self.index, snapshot)
+
+
+class LocalExecutor:
+    """Builds the physical plan from a DataflowGraph and runs it."""
+
+    def __init__(
+        self,
+        graph: DataflowGraph,
+        *,
+        channel_capacity: int = 1024,
+        metric_registry: typing.Optional[MetricRegistry] = None,
+        device_provider: typing.Optional[typing.Callable[[str, int], typing.Any]] = None,
+        mesh: typing.Optional[typing.Any] = None,
+        job_config: typing.Optional[dict] = None,
+        source_throttle_s: float = 0.0,
+        checkpoint_dir: typing.Optional[str] = None,
+    ):
+        from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
+
+        self.graph = graph
+        self.channel_capacity = channel_capacity
+        self.metrics = metric_registry or MetricRegistry()
+        self.device_provider = device_provider
+        self.mesh = mesh
+        self.job_config = job_config or {}
+        self.source_throttle_s = source_throttle_s
+        self.cancelled = threading.Event()
+        self._error: typing.Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        self.subtasks: typing.List[_Subtask] = []
+        self._gates: typing.List[InputGate] = []
+        self.coordinator = CheckpointCoordinator(self, checkpoint_dir)
+        self._build()
+
+    # --- plan construction ----------------------------------------------
+    def _build(self) -> None:
+        by_transformation: typing.Dict[int, typing.List[_Subtask]] = {}
+        gates: typing.Dict[typing.Tuple[int, int], InputGate] = {}
+
+        order = self.graph.topological_order()
+
+        # Pass 1: channel layout per downstream transformation.
+        # Forward edges contribute 1 channel per gate; others contribute
+        # the upstream parallelism.
+        channel_base: typing.Dict[typing.Tuple[int, int], int] = {}  # (down_id, edge_idx) -> base
+        gate_size: typing.Dict[int, int] = {}
+        for t in order:
+            base = 0
+            for edge_idx, edge in enumerate(t.inputs):
+                channel_base[(t.id, edge_idx)] = base
+                if isinstance(edge.partitioner, ForwardPartitioner):
+                    if edge.upstream.parallelism != t.parallelism:
+                        raise ValueError(
+                            f"forward edge {edge.upstream.name}->{t.name} requires equal "
+                            f"parallelism ({edge.upstream.parallelism} vs {t.parallelism})"
+                        )
+                    base += 1
+                else:
+                    base += edge.upstream.parallelism
+            gate_size[t.id] = base
+
+        # Pass 2: instantiate subtasks and gates.
+        for t in order:
+            subtasks = []
+            for i in range(t.parallelism):
+                operator = t.operator_factory()
+                gate = None
+                if not t.is_source:
+                    gate = InputGate(gate_size[t.id], capacity=self.channel_capacity)
+                    gates[(t.id, i)] = gate
+                    self._gates.append(gate)
+                st = _Subtask(self, t, i, operator, gate, gate_size[t.id])
+                subtasks.append(st)
+            by_transformation[t.id] = subtasks
+
+        # Pass 3: wire outputs.
+        for t in order:
+            downstream = [
+                (d, edge_idx, edge)
+                for d in self.graph.transformations
+                for edge_idx, edge in enumerate(d.inputs)
+                if edge.upstream.id == t.id
+            ]
+            for st in by_transformation[t.id]:
+                edges_for_output = []
+                for d, edge_idx, edge in downstream:
+                    base = channel_base[(d.id, edge_idx)]
+                    if isinstance(edge.partitioner, ForwardPartitioner):
+                        writers = [ChannelWriter(gates[(d.id, st.index)], base)]
+                    else:
+                        writers = [
+                            ChannelWriter(gates[(d.id, j)], base + st.index)
+                            for j in range(d.parallelism)
+                        ]
+                    # Stateful partitioners (e.g. rebalance round-robin) must
+                    # not be shared across upstream subtask threads.
+                    import copy
+
+                    edges_for_output.append((copy.deepcopy(edge.partitioner), writers))
+                st.output = Output(edges_for_output)
+                state = KeyedStateStore()
+                device = (
+                    self.device_provider(t.name, st.index) if self.device_provider else None
+                )
+                ctx = RuntimeContext(
+                    task_name=t.name,
+                    subtask_index=st.index,
+                    parallelism=t.parallelism,
+                    keyed_state=state,
+                    metric_group=self.metrics.group(st.scope),
+                    device=device,
+                    mesh=self.mesh,
+                    job_config=self.job_config,
+                )
+                st.operator.setup(ctx, st.output, state)
+                self.subtasks.append(st)
+
+    # --- restore ---------------------------------------------------------
+    def restore(self, snapshots: typing.Dict[str, typing.Dict[int, typing.Any]]) -> None:
+        for st in self.subtasks:
+            task_snaps = snapshots.get(st.t.name)
+            if task_snaps is None:
+                continue
+            snap = task_snaps.get(st.index)
+            if snap is not None:
+                st.operator.restore(snap)
+
+    # --- execution --------------------------------------------------------
+    def start(self) -> None:
+        for st in self.subtasks:
+            body = st.run_source if st.t.is_source else st.run_worker
+            st.thread = threading.Thread(target=body, name=st.scope, daemon=True)
+        for st in self.subtasks:
+            st.thread.start()
+
+    def join(self, timeout: typing.Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for st in self.subtasks:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            st.thread.join(remaining)
+            if st.thread.is_alive():
+                self.cancel()
+                raise JobFailure(f"timeout waiting for subtask {st.scope}")
+        if self._error is not None:
+            raise JobFailure(f"job failed: {self._error!r}") from self._error
+
+    def run(self, timeout: typing.Optional[float] = None) -> None:
+        self.start()
+        self.join(timeout)
+
+    # --- failure / teardown ----------------------------------------------
+    def fail(self, subtask: _Subtask, exc: BaseException) -> None:
+        with self._error_lock:
+            if self._error is None:
+                self._error = exc
+        logger.error("subtask %s failed", subtask.scope, exc_info=exc)
+        self.cancel()
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+        for gate in self._gates:
+            gate.close()
+        self.coordinator.cancel_pending()
+
+    def subtask_finished(self, subtask: _Subtask) -> None:
+        self.coordinator.subtask_finished(subtask)
+
+    @property
+    def total_subtasks(self) -> int:
+        return len(self.subtasks)

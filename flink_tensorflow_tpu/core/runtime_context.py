@@ -1,0 +1,45 @@
+"""Per-subtask runtime context handed to rich functions at ``open()``.
+
+Equivalent of Flink's ``RuntimeContext`` (subtask index, parallelism, metric
+group, keyed state access).  The TPU-native addition is device placement:
+each subtask may own a local device (operator-DP inference, one chip per
+subtask — SURVEY.md §7 step 4) or participate in a gang mesh (DP training,
+SURVEY.md §7 hard part 4).
+"""
+
+from __future__ import annotations
+
+import typing
+
+from flink_tensorflow_tpu.core.state import KeyedStateStore, StateDescriptor
+from flink_tensorflow_tpu.metrics.registry import MetricGroup
+
+if typing.TYPE_CHECKING:
+    import jax
+
+
+class RuntimeContext:
+    def __init__(
+        self,
+        task_name: str,
+        subtask_index: int,
+        parallelism: int,
+        keyed_state: KeyedStateStore,
+        metric_group: MetricGroup,
+        device: typing.Optional["jax.Device"] = None,
+        mesh: typing.Optional[typing.Any] = None,
+        job_config: typing.Optional[dict] = None,
+    ):
+        self.task_name = task_name
+        self.subtask_index = subtask_index
+        self.parallelism = parallelism
+        self._keyed_state = keyed_state
+        self.metrics = metric_group
+        #: Local device for per-subtask execution (operator-DP inference).
+        self.device = device
+        #: Shared jax.sharding.Mesh for gang operators (DP/TP training).
+        self.mesh = mesh
+        self.job_config = dict(job_config or {})
+
+    def state(self, descriptor: StateDescriptor):
+        return self._keyed_state.value_state(descriptor)

@@ -1,0 +1,240 @@
+"""DataStream API — the user-facing fluent stream-building layer.
+
+Equivalent of Flink's ``DataStream[T]``/``KeyedStream``/``WindowedStream``
+that the reference's jobs are written against (SURVEY.md §1 L1, §3.1:
+``stream.map(modelFunction)``; §3.2: ``stream.countWindowAll(B)``).
+
+Key API parity points:
+- ``map/flat_map/filter/process`` with rich-function lifecycle
+- ``key_by`` -> hash partitioning + keyed state (Wide&Deep workload)
+- ``count_window`` (+ timeout variant) -> micro-batch feeding one jitted call
+- checkpoint barriers handled by the runtime, not user code
+"""
+
+from __future__ import annotations
+
+import typing
+
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.graph import DataflowGraph, Edge, Transformation
+from flink_tensorflow_tpu.core.operators import (
+    FilterOperator,
+    FlatMapOperator,
+    MapOperator,
+    ProcessOperator,
+    SinkOperator,
+    WindowOperator,
+)
+from flink_tensorflow_tpu.core.partitioning import (
+    BroadcastPartitioner,
+    ForwardPartitioner,
+    HashPartitioner,
+    Partitioner,
+    RebalancePartitioner,
+)
+from flink_tensorflow_tpu.core.windows import CountOrTimeoutTrigger, CountTrigger, Trigger
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.environment import StreamExecutionEnvironment
+
+
+class _LambdaMap(fn.MapFunction):
+    def __init__(self, f):
+        self.f = f
+
+    def map(self, value):
+        return self.f(value)
+
+
+class _LambdaFlatMap(fn.FlatMapFunction):
+    def __init__(self, f):
+        self.f = f
+
+    def flat_map(self, value):
+        return self.f(value)
+
+
+class _LambdaFilter(fn.FilterFunction):
+    def __init__(self, f):
+        self.f = f
+
+    def filter(self, value):
+        return bool(self.f(value))
+
+
+class _ListSink(fn.SinkFunction):
+    def __init__(self, target: list, lock):
+        self.target = target
+        self.lock = lock
+
+    def clone(self):
+        return self  # all subtasks share the collection target on purpose
+
+    def invoke(self, value):
+        with self.lock:
+            self.target.append(value)
+
+
+class _CallableSink(fn.SinkFunction):
+    def __init__(self, f):
+        self.f = f
+
+    def invoke(self, value):
+        self.f(value)
+
+
+class DataStream:
+    """A (possibly re-partitioned) stream of records."""
+
+    def __init__(
+        self,
+        env: "StreamExecutionEnvironment",
+        transformation: Transformation,
+        partitioner: typing.Optional[Partitioner] = None,
+    ):
+        self.env = env
+        self.transformation = transformation
+        #: Partitioner to use for the NEXT hop (None = auto forward/rebalance).
+        self._partitioner = partitioner
+
+    # -- internal ---------------------------------------------------------
+    def _edge(self, downstream_parallelism: int) -> Edge:
+        p = self._partitioner
+        if p is None:
+            if downstream_parallelism == self.transformation.parallelism:
+                p = ForwardPartitioner()
+            else:
+                p = RebalancePartitioner()
+        return Edge(upstream=self.transformation, partitioner=p)
+
+    def _add_op(self, name, factory, parallelism) -> Transformation:
+        parallelism = parallelism or self.env.default_parallelism
+        return self.env.graph.add(
+            name, factory, parallelism, inputs=[self._edge(parallelism)]
+        )
+
+    # -- transforms -------------------------------------------------------
+    def map(self, f: typing.Union[fn.MapFunction, typing.Callable], *, name="map", parallelism=None) -> "DataStream":
+        func = f if isinstance(f, fn.MapFunction) else _LambdaMap(f)
+        t = self._add_op(name, lambda: MapOperator(name, func), parallelism)
+        return DataStream(self.env, t)
+
+    def flat_map(self, f, *, name="flat_map", parallelism=None) -> "DataStream":
+        func = f if isinstance(f, fn.FlatMapFunction) else _LambdaFlatMap(f)
+        t = self._add_op(name, lambda: FlatMapOperator(name, func), parallelism)
+        return DataStream(self.env, t)
+
+    def filter(self, f, *, name="filter", parallelism=None) -> "DataStream":
+        func = f if isinstance(f, fn.FilterFunction) else _LambdaFilter(f)
+        t = self._add_op(name, lambda: FilterOperator(name, func), parallelism)
+        return DataStream(self.env, t)
+
+    def process(self, f: fn.ProcessFunction, *, name="process", parallelism=None) -> "DataStream":
+        t = self._add_op(name, lambda: ProcessOperator(name, f), parallelism)
+        return DataStream(self.env, t)
+
+    # -- partitioning -----------------------------------------------------
+    def key_by(self, key_selector: typing.Callable[[typing.Any], typing.Any]) -> "KeyedStream":
+        return KeyedStream(self.env, self.transformation, key_selector)
+
+    def rebalance(self) -> "DataStream":
+        return DataStream(self.env, self.transformation, RebalancePartitioner())
+
+    def broadcast(self) -> "DataStream":
+        return DataStream(self.env, self.transformation, BroadcastPartitioner())
+
+    def union(self, *others: "DataStream") -> "UnionStream":
+        return UnionStream(self.env, [self, *others])
+
+    # -- windows ----------------------------------------------------------
+    def count_window(
+        self, size: int, *, timeout_s: typing.Optional[float] = None
+    ) -> "WindowedStream":
+        """Per-subtask tumbling count window (the micro-batch primitive).
+
+        ``timeout_s`` turns it into the adaptive count-or-timeout batcher
+        bounding p50 latency (SURVEY.md §7 hard part 3).
+        """
+        trigger = (
+            CountTrigger(size) if timeout_s is None else CountOrTimeoutTrigger(size, timeout_s)
+        )
+        return WindowedStream(self.env, self, trigger, key_selector=None)
+
+    # -- sinks ------------------------------------------------------------
+    def add_sink(self, sink: fn.SinkFunction, *, name="sink", parallelism=None) -> Transformation:
+        return self._add_op(name, lambda: SinkOperator(name, sink), parallelism)
+
+    def sink_to_callable(self, f: typing.Callable, *, name="sink", parallelism=None) -> Transformation:
+        return self.add_sink(_CallableSink(f), name=name, parallelism=parallelism)
+
+    def sink_to_list(self, *, name="collect", parallelism=None) -> list:
+        """Collect results into a list materialized during execute()."""
+        import threading
+
+        out: list = []
+        lock = threading.Lock()
+        self.add_sink(_ListSink(out, lock), name=name, parallelism=parallelism)
+        return out
+
+
+class UnionStream(DataStream):
+    """Merge of several streams; next operator reads all of them."""
+
+    def __init__(self, env, streams: typing.List[DataStream]):
+        super().__init__(env, streams[0].transformation)
+        self._streams = streams
+
+    def _add_op(self, name, factory, parallelism):
+        parallelism = parallelism or self.env.default_parallelism
+        edges = [s._edge(parallelism) for s in self._streams]
+        return self.env.graph.add(name, factory, parallelism, inputs=edges)
+
+
+class KeyedStream:
+    """Stream partitioned by key; downstream ops get keyed state."""
+
+    def __init__(self, env, transformation: Transformation, key_selector):
+        self.env = env
+        self.transformation = transformation
+        self.key_selector = key_selector
+
+    def _edge(self) -> Edge:
+        return Edge(self.transformation, HashPartitioner(self.key_selector))
+
+    def process(self, f: fn.ProcessFunction, *, name="keyed_process", parallelism=None) -> DataStream:
+        parallelism = parallelism or self.env.default_parallelism
+        t = self.env.graph.add(
+            name,
+            lambda: ProcessOperator(name, f, key_selector=self.key_selector),
+            parallelism,
+            inputs=[self._edge()],
+        )
+        return DataStream(self.env, t)
+
+    def count_window(self, size: int, *, timeout_s: typing.Optional[float] = None) -> "WindowedStream":
+        trigger = (
+            CountTrigger(size) if timeout_s is None else CountOrTimeoutTrigger(size, timeout_s)
+        )
+        return WindowedStream(self.env, self, trigger, key_selector=self.key_selector)
+
+
+class WindowedStream:
+    def __init__(self, env, upstream, trigger: Trigger, key_selector):
+        self.env = env
+        self.upstream = upstream  # DataStream or KeyedStream
+        self.trigger = trigger
+        self.key_selector = key_selector
+
+    def apply(self, f: fn.WindowFunction, *, name="window", parallelism=None) -> DataStream:
+        parallelism = parallelism or self.env.default_parallelism
+        if isinstance(self.upstream, KeyedStream):
+            edge = self.upstream._edge()
+        else:
+            edge = self.upstream._edge(parallelism)
+        t = self.env.graph.add(
+            name,
+            lambda: WindowOperator(name, f, self.trigger, key_selector=self.key_selector),
+            parallelism,
+            inputs=[edge],
+        )
+        return DataStream(self.env, t)

@@ -1,0 +1,99 @@
+"""Window assigners and triggers — micro-batching building blocks.
+
+The reference's central performance mechanism is "Flink's windowed
+micro-batching feeds" the model (BASELINE.json:4, :7): a count window turns
+N single records into one batched ``Session.run``.  On TPU the same window
+feeds one ``jax.jit`` call on a ``[B, ...]`` array (SURVEY.md §3.2), so the
+window/trigger design directly controls MXU utilization and p50 latency:
+
+- count trigger  -> fixed batch B (full MXU tiles, best throughput)
+- timeout hybrid -> flush on count OR deadline (bounds p50 latency; see
+  SURVEY.md §7 hard part 3 "adaptive batching")
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import typing
+
+
+@dataclasses.dataclass(frozen=True)
+class CountWindow:
+    """Identifies the n-th tumbling count window for a key/subtask."""
+
+    index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeWindow:
+    start: float
+    end: float
+
+
+class WindowAssigner:
+    def assign(self, value: typing.Any, timestamp: typing.Optional[float]) -> typing.Any:
+        raise NotImplementedError
+
+
+class Trigger:
+    """Decides when a window fires. Returns True to fire-and-purge."""
+
+    def on_element(self, window_state: "WindowBuffer") -> bool:
+        raise NotImplementedError
+
+    def deadline(self, window_state: "WindowBuffer") -> typing.Optional[float]:
+        """Processing-time deadline at which the window must flush, or None."""
+        return None
+
+
+class CountTrigger(Trigger):
+    def __init__(self, count: int):
+        if count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        self.count = count
+
+    def on_element(self, window_state):
+        return len(window_state.elements) >= self.count
+
+
+class CountOrTimeoutTrigger(Trigger):
+    """Fire at B elements or ``timeout_s`` after the first element.
+
+    This is the adaptive-batching policy that reconciles the reference's
+    throughput-oriented count windows with the north-star p50 latency
+    target (BASELINE.json:2): a sparse stream never waits more than
+    ``timeout_s`` for a full batch.
+    """
+
+    def __init__(self, count: int, timeout_s: float):
+        if count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+        self.count = count
+        self.timeout_s = timeout_s
+
+    def on_element(self, window_state):
+        return len(window_state.elements) >= self.count
+
+    def deadline(self, window_state):
+        if not window_state.elements:
+            return None
+        return window_state.first_element_time + self.timeout_s
+
+
+@dataclasses.dataclass
+class WindowBuffer:
+    """Accumulating contents of one in-flight window."""
+
+    window: typing.Any
+    elements: typing.List[typing.Any] = dataclasses.field(default_factory=list)
+    timestamps: typing.List[typing.Optional[float]] = dataclasses.field(default_factory=list)
+    first_element_time: float = 0.0
+
+    def add(self, value: typing.Any, timestamp: typing.Optional[float]) -> None:
+        if not self.elements:
+            self.first_element_time = time.monotonic()
+        self.elements.append(value)
+        self.timestamps.append(timestamp)

@@ -1,0 +1,7 @@
+from flink_tensorflow_tpu.io.sources import (
+    CollectionSource,
+    GeneratorSource,
+    ThrottledSource,
+)
+
+__all__ = ["CollectionSource", "GeneratorSource", "ThrottledSource"]

@@ -1,0 +1,9 @@
+from flink_tensorflow_tpu.metrics.registry import (
+    Counter,
+    Histogram,
+    Meter,
+    MetricGroup,
+    MetricRegistry,
+)
+
+__all__ = ["Counter", "Histogram", "Meter", "MetricGroup", "MetricRegistry"]

@@ -1,0 +1,140 @@
+"""Metrics — counters, meters, latency histograms per operator subtask.
+
+The reference exposes Flink metric groups (counters/meters per operator,
+SURVEY.md §5 "Metrics").  Here records/sec/chip and p50/p99 per-record
+latency are first-class because they ARE the north-star metric
+(BASELINE.json:2).  Histograms keep a bounded reservoir so the hot path
+stays O(1) with no allocation beyond a float append.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import typing
+
+import numpy as np
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Meter:
+    """Rate meter: events/sec over the job's lifetime and a sliding window."""
+
+    __slots__ = ("count", "_start", "_win_count", "_win_start")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self._start = time.monotonic()
+        self._win_count = 0
+        self._win_start = self._start
+
+    def mark(self, n: int = 1) -> None:
+        self.count += n
+        self._win_count += n
+
+    def rate(self) -> float:
+        elapsed = time.monotonic() - self._start
+        return self.count / elapsed if elapsed > 0 else 0.0
+
+    def window_rate(self) -> float:
+        now = time.monotonic()
+        elapsed = now - self._win_start
+        rate = self._win_count / elapsed if elapsed > 0 else 0.0
+        self._win_count = 0
+        self._win_start = now
+        return rate
+
+
+class Histogram:
+    """Bounded-reservoir histogram for latency percentiles."""
+
+    __slots__ = ("_samples", "_capacity", "count")
+
+    def __init__(self, capacity: int = 65536):
+        self._samples: typing.List[float] = []
+        self._capacity = capacity
+        self.count = 0
+
+    def record(self, value: float) -> None:
+        self.count += 1
+        if len(self._samples) < self._capacity:
+            self._samples.append(value)
+        else:
+            # Reservoir sampling keeps percentiles unbiased under overflow.
+            j = np.random.randint(0, self.count)
+            if j < self._capacity:
+                self._samples[j] = value
+
+    def percentile(self, q: float) -> float:
+        if not self._samples:
+            return float("nan")
+        return float(np.percentile(np.asarray(self._samples), q))
+
+    def summary(self) -> typing.Dict[str, float]:
+        return {
+            "count": float(self.count),
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+            "mean": float(np.mean(self._samples)) if self._samples else float("nan"),
+        }
+
+
+class MetricGroup:
+    """Namespaced metric container for one operator subtask."""
+
+    def __init__(self, scope: str, registry: "MetricRegistry"):
+        self.scope = scope
+        self._registry = registry
+
+    def counter(self, name: str) -> Counter:
+        return self._registry._get(self.scope, name, Counter)
+
+    def meter(self, name: str) -> Meter:
+        return self._registry._get(self.scope, name, Meter)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._registry._get(self.scope, name, Histogram)
+
+
+class MetricRegistry:
+    def __init__(self) -> None:
+        self._metrics: typing.Dict[typing.Tuple[str, str], typing.Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, scope: str, name: str, factory: typing.Callable[[], typing.Any]):
+        key = (scope, name)
+        with self._lock:
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = factory()
+                self._metrics[key] = metric
+            return metric
+
+    def group(self, scope: str) -> MetricGroup:
+        return MetricGroup(scope, self)
+
+    def all_metrics(self) -> typing.Dict[typing.Tuple[str, str], typing.Any]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def report(self) -> typing.Dict[str, typing.Any]:
+        out: typing.Dict[str, typing.Any] = {}
+        for (scope, name), metric in self.all_metrics().items():
+            key = f"{scope}.{name}"
+            if isinstance(metric, Counter):
+                out[key] = metric.value
+            elif isinstance(metric, Meter):
+                out[key] = {"count": metric.count, "rate": metric.rate()}
+            elif isinstance(metric, Histogram):
+                out[key] = metric.summary()
+        return out

@@ -1,0 +1,23 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's MiniCluster strategy (SURVEY.md §4): Flink projects
+test "multi-node" in one JVM; we test multi-chip sharding on virtual CPU
+devices.  Env vars must be set before jax initializes its backends, hence
+at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def env():
+    from flink_tensorflow_tpu import StreamExecutionEnvironment
+
+    return StreamExecutionEnvironment(parallelism=2)

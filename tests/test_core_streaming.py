@@ -1,0 +1,215 @@
+"""Core streaming kernel tests: transforms, partitioning, windows, state.
+
+Mirrors the reference's unit-test shape (SURVEY.md §4): small bounded jobs
+through the in-process executor, asserting exact outputs.
+"""
+
+import collections
+
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core.functions import (
+    Collector,
+    ProcessFunction,
+    WindowFunction,
+)
+from flink_tensorflow_tpu.core.state import StateDescriptor
+
+
+def test_map_filter_pipeline():
+    env = StreamExecutionEnvironment(parallelism=2)
+    out = (
+        env.from_collection(list(range(100)))
+        .map(lambda x: x * 2)
+        .filter(lambda x: x % 4 == 0)
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    assert sorted(out) == [x * 2 for x in range(100) if (x * 2) % 4 == 0]
+
+
+def test_flat_map():
+    env = StreamExecutionEnvironment(parallelism=2)
+    out = (
+        env.from_collection(["a b", "c d e"])
+        .flat_map(lambda s: s.split())
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    assert sorted(out) == ["a", "b", "c", "d", "e"]
+
+
+def test_parallel_source_emits_exactly_once():
+    env = StreamExecutionEnvironment(parallelism=4)
+    out = env.from_collection(list(range(1000)), parallelism=4).sink_to_list()
+    env.execute(timeout=30)
+    assert sorted(out) == list(range(1000))
+
+
+def test_key_by_routes_same_key_to_same_subtask():
+    env = StreamExecutionEnvironment(parallelism=4)
+
+    class TagSubtask(ProcessFunction):
+        def open(self, ctx):
+            self.idx = ctx.subtask_index
+
+        def process_element(self, value, ctx, out: Collector):
+            out.collect((value[0], self.idx))
+
+    data = [(f"k{i % 7}", i) for i in range(200)]
+    out = (
+        env.from_collection(data)
+        .key_by(lambda kv: kv[0])
+        .process(TagSubtask(), parallelism=4)
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    subtask_of = collections.defaultdict(set)
+    for key, idx in out:
+        subtask_of[key].add(idx)
+    assert len(out) == 200
+    for key, idxs in subtask_of.items():
+        assert len(idxs) == 1, f"key {key} hit multiple subtasks {idxs}"
+
+
+def test_keyed_state_accumulates_per_key():
+    env = StreamExecutionEnvironment(parallelism=2)
+    COUNT = StateDescriptor("count", default_factory=lambda: 0)
+
+    class Counter(ProcessFunction):
+        def process_element(self, value, ctx, out):
+            state = ctx.state(COUNT)
+            n = state.value() + 1
+            state.update(n)
+            out.collect((ctx.current_key, n))
+
+    data = [("a", i) for i in range(10)] + [("b", i) for i in range(5)]
+    out = (
+        env.from_collection(data)
+        .key_by(lambda kv: kv[0])
+        .process(Counter(), parallelism=2)
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    finals = {}
+    for key, n in out:
+        finals[key] = max(finals.get(key, 0), n)
+    assert finals == {"a": 10, "b": 5}
+
+
+class BatchSum(WindowFunction):
+    def process_window(self, key, window, elements, out: Collector):
+        out.collect((key, len(elements), sum(elements)))
+
+
+def test_count_window_micro_batch():
+    env = StreamExecutionEnvironment(parallelism=1)
+    out = (
+        env.from_collection(list(range(10)))
+        .count_window(4)
+        .apply(BatchSum(), parallelism=1)
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    # 4 + 4 + final flush of 2
+    sizes = sorted(n for _, n, _ in out)
+    assert sizes == [2, 4, 4]
+    assert sum(s for _, _, s in out) == sum(range(10))
+
+
+def test_keyed_count_window():
+    env = StreamExecutionEnvironment(parallelism=2)
+    data = [("a", 1)] * 6 + [("b", 2)] * 3
+    out = (
+        env.from_collection(data)
+        .key_by(lambda kv: kv[0])
+        .count_window(2)
+        .apply(
+            type(
+                "KeyedBatch",
+                (WindowFunction,),
+                {
+                    "process_window": lambda self, key, window, elements, out: out.collect(
+                        (key, len(elements))
+                    )
+                },
+            )(),
+            parallelism=2,
+        )
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    by_key = collections.defaultdict(list)
+    for key, n in out:
+        by_key[key].append(n)
+    assert sorted(by_key["a"]) == [2, 2, 2]
+    assert sorted(by_key["b"]) == [1, 2]
+
+
+def test_count_or_timeout_window_flushes_partial_batch():
+    import time
+
+    env = StreamExecutionEnvironment(parallelism=1)
+    env.source_throttle_s = 0.06
+
+    out = (
+        env.from_collection(list(range(5)))
+        .count_window(100, timeout_s=0.03)
+        .apply(BatchSum(), parallelism=1)
+        .sink_to_list()
+    )
+    start = time.monotonic()
+    env.execute(timeout=30)
+    elapsed = time.monotonic() - start
+    # Timeout (not the count of 100, nor only the end-of-stream flush)
+    # must have produced batches: with a 10ms throttle and a 50ms timeout,
+    # the 5 records cannot all be in one window.
+    assert sum(n for _, n, _ in out) == 5
+    assert len(out) >= 2, f"expected timeout flushes, got one batch: {out}"
+    assert elapsed < 10
+
+
+def test_union():
+    env = StreamExecutionEnvironment(parallelism=2)
+    s1 = env.from_collection([1, 2, 3])
+    s2 = env.from_collection([10, 20])
+    out = s1.union(s2).map(lambda x: x + 1).sink_to_list()
+    env.execute(timeout=30)
+    assert sorted(out) == [2, 3, 4, 11, 21]
+
+
+def test_rebalance_distributes_records():
+    env = StreamExecutionEnvironment(parallelism=4)
+
+    class Tag(ProcessFunction):
+        def open(self, ctx):
+            self.idx = ctx.subtask_index
+
+        def process_element(self, value, ctx, out):
+            out.collect(self.idx)
+
+    out = (
+        env.from_collection(list(range(64)))
+        .rebalance()
+        .process(Tag(), parallelism=4)
+        .sink_to_list()
+    )
+    env.execute(timeout=30)
+    counts = collections.Counter(out)
+    assert sum(counts.values()) == 64
+    assert len(counts) == 4
+    assert all(c == 16 for c in counts.values())
+
+
+def test_error_propagates():
+    env = StreamExecutionEnvironment(parallelism=1)
+
+    def boom(x):
+        raise ValueError("boom")
+
+    env.from_collection([1]).map(boom).sink_to_list()
+    from flink_tensorflow_tpu.core.runtime import JobFailure
+
+    with pytest.raises(JobFailure):
+        env.execute(timeout=30)
