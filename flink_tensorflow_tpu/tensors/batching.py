@@ -1,0 +1,163 @@
+"""Micro-batch assembly: stack, pad, bucket.
+
+This is the bridge from the window operator's fired element list (SURVEY.md
+§3.2 — "stack B records -> one batched input tensor") to an XLA-friendly
+``[B, ...]`` pytree.  Two TPU constraints shape the design (SURVEY.md §7
+hard part 2):
+
+1. **Static shapes only.** Streaming batch sizes vary per window fire, and
+   BiLSTM-style records vary in length.  Every dynamic dimension — batch and
+   sequence alike — is padded up to a bucket from a fixed ladder, so the
+   jit compile cache stays small and warm (one executable per bucket tuple).
+2. **One transfer per batch.** Records are stacked into a single contiguous
+   host buffer per field and shipped to HBM in one ``device_put`` — never
+   per record (the reference's per-record JNI copy is the hot-loop cost its
+   own micro-batching exists to amortize, SURVEY.md §3.1).
+
+A ``Batch`` carries ``valid`` (rows that are real records, not batch pad)
+and per-field length arrays for sequence fields, so downstream unbatching
+drops padding losslessly.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import typing
+
+import numpy as np
+
+from flink_tensorflow_tpu.tensors.schema import RecordSchema
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+
+class BucketLadder:
+    """Monotone ladder of sizes; values round up to the next rung.
+
+    Defaults to powers of two — the geometric ladder bounds both padding
+    waste (<2x) and the number of compiled executables (log2(max)).
+    """
+
+    def __init__(self, sizes: typing.Optional[typing.Sequence[int]] = None, *, max_size: int = 4096):
+        if sizes is None:
+            sizes, s = [], 1
+            while s <= max_size:
+                sizes.append(s)
+                s *= 2
+        self.sizes = sorted(set(int(s) for s in sizes))
+        if not self.sizes:
+            raise ValueError("bucket ladder must be non-empty")
+
+    def round_up(self, n: int) -> int:
+        i = bisect.bisect_left(self.sizes, n)
+        if i == len(self.sizes):
+            raise ValueError(f"size {n} exceeds largest bucket {self.sizes[-1]}")
+        return self.sizes[i]
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPolicy:
+    """How a model operator resolves dynamic dims to static shapes."""
+
+    batch: BucketLadder = dataclasses.field(default_factory=BucketLadder)
+    #: Ladder for every dynamic (non-batch) dim, e.g. sequence length.
+    lengths: BucketLadder = dataclasses.field(default_factory=lambda: BucketLadder(max_size=8192))
+    #: If set, batches are always padded to exactly this size (no ladder).
+    fixed_batch: typing.Optional[int] = None
+
+    def batch_bucket(self, n: int) -> int:
+        return self.fixed_batch if self.fixed_batch is not None else self.batch.round_up(n)
+
+
+@dataclasses.dataclass
+class Batch:
+    """One assembled micro-batch (host side, pre-transfer).
+
+    ``arrays``: field -> ``[B, ...]`` numpy array (B = bucketed batch).
+    ``valid``: ``[B]`` bool — False rows are batch padding.
+    ``lengths``: field -> ``[B]`` int32 true lengths, for fields whose
+    leading record dim was dynamic (sequence fields).
+    ``metas``: per-record metadata from the source TensorValues.
+    """
+
+    arrays: typing.Dict[str, np.ndarray]
+    valid: np.ndarray
+    lengths: typing.Dict[str, np.ndarray]
+    metas: typing.List[typing.Mapping[str, typing.Any]]
+
+    @property
+    def num_records(self) -> int:
+        return int(self.valid.sum())
+
+    @property
+    def padded_size(self) -> int:
+        return int(self.valid.shape[0])
+
+    def bucket_key(self) -> typing.Tuple:
+        """Compile-cache key: every static shape the jitted call sees."""
+        return tuple(sorted((n, a.shape, str(a.dtype)) for n, a in self.arrays.items()))
+
+    def unbatch(
+        self, outputs: typing.Mapping[str, np.ndarray]
+    ) -> typing.List[TensorValue]:
+        """Split a model's ``[B, ...]`` outputs back into per-record values,
+        dropping batch-pad rows and re-attaching each record's metadata."""
+        out_host = {n: np.asarray(a) for n, a in outputs.items()}
+        records = []
+        for i in range(self.padded_size):
+            if not self.valid[i]:
+                continue
+            records.append(
+                TensorValue({n: a[i] for n, a in out_host.items()}, self.metas[len(records)])
+            )
+        return records
+
+
+def assemble(
+    records: typing.Sequence[TensorValue],
+    schema: RecordSchema,
+    policy: typing.Optional[BucketPolicy] = None,
+) -> Batch:
+    """Stack records into one bucketed, padded micro-batch.
+
+    Dynamic dims (``None`` in the schema) are padded per the policy's length
+    ladder; the batch dim is padded per the batch ladder.  Pad rows replay
+    the first record's values so the padded computation hits no NaN/inf
+    paths — ``valid`` masks them out downstream.
+    """
+    if not records:
+        raise ValueError("cannot assemble an empty batch")
+    policy = policy or BucketPolicy()
+    n = len(records)
+    b = policy.batch_bucket(n)
+
+    arrays: typing.Dict[str, np.ndarray] = {}
+    lengths: typing.Dict[str, np.ndarray] = {}
+    for name, spec in schema:
+        parts = [np.asarray(r[name]) for r in records]
+        dyn_axes = [ax for ax, d in enumerate(spec.shape) if d is None]
+        if dyn_axes:
+            # Bucket every dynamic axis to the max length's rung.
+            target = list(parts[0].shape)
+            for ax in dyn_axes:
+                target[ax] = policy.lengths.round_up(max(p.shape[ax] for p in parts))
+            # True length on the first dynamic axis (the sequence axis).
+            lengths[name] = np.array(
+                [p.shape[dyn_axes[0]] for p in parts] + [0] * (b - n), dtype=np.int32
+            )
+            padded = np.zeros((b, *target), dtype=spec.dtype)
+            for i, p in enumerate(parts):
+                padded[(i, *(slice(0, s) for s in p.shape))] = p
+            if b > n:  # batch pad replays record 0
+                padded[n:] = padded[0]
+            arrays[name] = padded
+        else:
+            stacked = np.stack(parts).astype(spec.dtype, copy=False)
+            if b > n:
+                pad = np.broadcast_to(stacked[0], (b - n, *stacked.shape[1:]))
+                stacked = np.concatenate([stacked, pad], axis=0)
+            arrays[name] = np.ascontiguousarray(stacked)
+
+    valid = np.zeros((b,), dtype=bool)
+    valid[:n] = True
+    return Batch(arrays=arrays, valid=valid, lengths=lengths, metas=[r.meta for r in records])

@@ -1,0 +1,110 @@
+"""Tensor coercion — host values in, TensorValue records out.
+
+Equivalent of the reference's implicit-conversion layer ("Row<->DeviceArray
+marshalling in the tensor-coercion layer", BASELINE.json:5; SURVEY.md §2
+"Tensor coercion / injections": Scala arrays / images / Rows -> tensors).
+Scala implicits become an explicit, inspectable converter registry — same
+capability, but conversions are resolved once per schema (not per record via
+implicit search) and the result is always a host numpy record; device
+placement is the batcher's job.
+"""
+
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+Converter = typing.Callable[[typing.Any, TensorSpec], np.ndarray]
+
+_CONVERTERS: typing.List[typing.Tuple[typing.Callable[[typing.Any], bool], Converter]] = []
+
+
+def register_converter(predicate: typing.Callable[[typing.Any], bool], converter: Converter) -> None:
+    """Register a coercion rule; later registrations win (user overrides)."""
+    _CONVERTERS.insert(0, (predicate, converter))
+
+
+def _convert_array_like(value, spec: TensorSpec) -> np.ndarray:
+    arr = np.asarray(value)
+    if arr.dtype != spec.dtype:
+        arr = arr.astype(spec.dtype)
+    # Rank promotion: a scalar for a () field, a flat list for a (d,) field.
+    if arr.ndim != spec.rank:
+        target = tuple(d for d in spec.shape if d is not None)
+        if arr.ndim == 0 and spec.rank == 0:
+            pass
+        elif len(target) == spec.rank and arr.size == int(np.prod(target)):
+            arr = arr.reshape(target)
+        else:
+            raise TypeError(
+                f"cannot coerce array of shape {arr.shape} to spec {spec.shape}"
+            )
+    spec.validate(arr)
+    return arr
+
+
+def coerce_field(value: typing.Any, spec: TensorSpec) -> np.ndarray:
+    for predicate, converter in _CONVERTERS:
+        if predicate(value):
+            out = converter(value, spec)
+            spec.validate(out)
+            return out
+    return _convert_array_like(value, spec)
+
+
+def coerce(value: typing.Any, schema: RecordSchema) -> TensorValue:
+    """Coerce an arbitrary host value into a schema-conforming TensorValue.
+
+    Accepted inputs (the reference's injection set, SURVEY.md §2):
+    - ``TensorValue`` — validated as-is (field subset selected if needed)
+    - mapping (a "Row"): field name -> array-like
+    - tuple/list matching the schema's field order
+    - single array-like, when the schema has exactly one field
+    """
+    if isinstance(value, TensorValue):
+        fields = {n: value[n] for n in schema.names}
+        out = TensorValue({n: coerce_field(a, schema[n]) for n, a in fields.items()}, value.meta)
+        return out
+    if isinstance(value, typing.Mapping):
+        missing = set(schema.names) - set(value)
+        if missing:
+            raise TypeError(f"row missing fields {missing}")
+        return TensorValue({n: coerce_field(value[n], schema[n]) for n in schema.names})
+    if isinstance(value, (tuple, list)) and len(schema.names) > 1:
+        if len(value) != len(schema.names):
+            raise TypeError(
+                f"row of {len(value)} columns does not match schema {schema.names}"
+            )
+        return TensorValue(
+            {n: coerce_field(v, schema[n]) for n, v in zip(schema.names, value)}
+        )
+    if len(schema.names) == 1:
+        name = schema.names[0]
+        return TensorValue({name: coerce_field(value, schema[name])})
+    raise TypeError(f"cannot coerce {type(value).__name__} to {schema}")
+
+
+# -- image coercion (Inception/MNIST workloads) -----------------------------
+
+def image_to_float(
+    image: np.ndarray,
+    *,
+    scale: float = 1.0 / 255.0,
+    offset: float = 0.0,
+    dtype=np.float32,
+) -> np.ndarray:
+    """uint8 HWC image -> scaled float tensor.
+
+    Host-side analogue of the reference's programmatically-built image
+    normalization graph in the Inception example (SURVEY.md §2 "Examples").
+    The device-side fused version lives in ops.preprocessing; use this one
+    only when records arrive as raw bytes and must be normalized per record.
+    """
+    img = np.asarray(image)
+    if img.dtype == np.uint8:
+        img = img.astype(dtype)
+    return (img * scale + offset).astype(dtype)

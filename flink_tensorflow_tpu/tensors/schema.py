@@ -1,0 +1,120 @@
+"""Record schemas — the TypeInformation equivalent of the tensor layer.
+
+The reference registers tensors with Flink's type system via a
+``TensorTypeInfo`` + serializer so tensor records can cross operator and
+network boundaries (SURVEY.md §2 "Tensor TypeInformation/serializer",
+BASELINE.json:5 tensor-coercion layer).  The TPU-native design replaces the
+class-per-type serializer machinery with a declarative schema: a record is a
+flat mapping ``field -> ndarray`` and its schema is ``field -> TensorSpec``.
+Schemas are pytree-shaped, so they line up 1:1 with the jit-side world:
+``jax.eval_shape``, ``NamedSharding`` annotation, and donation all key off
+the same structure.
+
+Dynamic dims are spelled ``None`` (e.g. variable sequence length); the
+batching layer resolves them to bucket sizes before anything reaches XLA, so
+jitted code only ever sees static shapes (SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Shape/dtype contract for one record field.
+
+    ``shape`` is the per-record shape (no batch dim); ``None`` entries are
+    dynamic and must be resolved by bucketing before device dispatch.
+    """
+
+    shape: typing.Tuple[typing.Optional[int], ...]
+    dtype: typing.Any = np.float32
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(self.shape))
+        object.__setattr__(self, "dtype", np.dtype(self.dtype))
+
+    @property
+    def is_static(self) -> bool:
+        return all(d is not None for d in self.shape)
+
+    @property
+    def rank(self) -> int:
+        return len(self.shape)
+
+    def validate(self, array: np.ndarray) -> None:
+        if array.ndim != self.rank:
+            raise TypeError(
+                f"rank mismatch: spec {self.shape} vs array shape {array.shape}"
+            )
+        for want, got in zip(self.shape, array.shape):
+            if want is not None and want != got:
+                raise TypeError(
+                    f"shape mismatch: spec {self.shape} vs array shape {array.shape}"
+                )
+        if array.dtype != self.dtype:
+            raise TypeError(f"dtype mismatch: spec {self.dtype} vs array {array.dtype}")
+
+    def with_batch(self, batch: int) -> typing.Tuple[int, ...]:
+        """Static batched shape; dynamic dims must already be resolved."""
+        if not self.is_static:
+            raise ValueError(f"cannot batch dynamic spec {self.shape} without bucketing")
+        return (batch, *self.shape)
+
+
+class RecordSchema:
+    """Ordered mapping field -> TensorSpec describing one stream record."""
+
+    def __init__(self, fields: typing.Mapping[str, TensorSpec]):
+        self.fields: typing.Dict[str, TensorSpec] = dict(fields)
+
+    def __iter__(self):
+        return iter(self.fields.items())
+
+    def __getitem__(self, name: str) -> TensorSpec:
+        return self.fields[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.fields
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, RecordSchema) and self.fields == other.fields
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}: {v.shape}/{v.dtype}" for k, v in self.fields.items())
+        return f"RecordSchema({inner})"
+
+    @property
+    def names(self) -> typing.List[str]:
+        return list(self.fields.keys())
+
+    @property
+    def is_static(self) -> bool:
+        return all(spec.is_static for spec in self.fields.values())
+
+    def validate(self, record: typing.Mapping[str, np.ndarray]) -> None:
+        missing = set(self.fields) - set(record)
+        extra = set(record) - set(self.fields)
+        if missing or extra:
+            raise TypeError(f"record fields mismatch: missing={missing} extra={extra}")
+        for name, spec in self.fields.items():
+            spec.validate(np.asarray(record[name]))
+
+    def batched_struct(self, batch: int):
+        """``jax.ShapeDtypeStruct`` pytree for a ``[B, ...]`` batch — feeds
+        ``jax.eval_shape``/AOT compilation without materializing data."""
+        import jax
+
+        return {
+            name: jax.ShapeDtypeStruct(spec.with_batch(batch), spec.dtype)
+            for name, spec in self.fields.items()
+        }
+
+
+def spec(shape, dtype=np.float32) -> TensorSpec:
+    """Shorthand constructor: ``spec((224, 224, 3), np.uint8)``."""
+    return TensorSpec(tuple(shape), dtype)

@@ -1,0 +1,56 @@
+"""Host <-> HBM transfer for assembled batches.
+
+The reference crosses the JVM->native boundary with a heap copy per tensor
+per record (SURVEY.md §3.1).  Here the entire batch pytree moves in one
+``jax.device_put`` call per direction, arrays are donated into the jitted
+call wherever the caller permits (input buffers are dead after the call, so
+XLA reuses their HBM pages for outputs — BASELINE.json:5 "donated,
+HBM-resident device arrays"), and result fetches overlap compute via
+jax's async dispatch: ``fetch`` only forces the transfer when the batch's
+consumer actually reads it.
+"""
+
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from flink_tensorflow_tpu.tensors.batching import Batch
+
+
+class DeviceTransfer:
+    """Per-operator-subtask transfer helper bound to one device (or sharding).
+
+    ``device`` may be a ``jax.Device``, a ``Sharding``, or None (jit default
+    placement).  One instance per model operator subtask — created at
+    ``open()`` alongside the compiled executable.
+    """
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def to_device(self, batch: Batch) -> typing.Dict[str, typing.Any]:
+        """Ship all batch fields to HBM in one transfer."""
+        import jax
+
+        if self.device is None:
+            return {n: jax.device_put(a) for n, a in batch.arrays.items()}
+        return jax.device_put(batch.arrays, self.device)
+
+    def lengths_to_device(self, batch: Batch) -> typing.Dict[str, typing.Any]:
+        import jax
+
+        if not batch.lengths:
+            return {}
+        if self.device is None:
+            return {n: jax.device_put(a) for n, a in batch.lengths.items()}
+        return jax.device_put(batch.lengths, self.device)
+
+    @staticmethod
+    def fetch(outputs) -> typing.Dict[str, np.ndarray]:
+        """Device -> host for a pytree of outputs (blocks on the transfer)."""
+        import jax
+
+        host = jax.device_get(outputs)
+        return {n: np.asarray(a) for n, a in host.items()}

@@ -1,0 +1,134 @@
+"""TensorValue — the serializable tensor record.
+
+Equivalent of the reference's ``TensorValue`` wrapper (SURVEY.md §2: a
+"serializable, immutable tensor holder usable as a Flink record" that
+converts to/from live ``org.tensorflow.Tensor`` handles).  The TPU-native
+version holds host-side numpy buffers (cheap to move between operator
+subtasks, picklable for checkpoints) and converts to device-resident
+``jax.Array`` values only at the model-operator boundary — one transfer per
+micro-batch, not per record, which is the reference's main latency sin
+(per-record JNI copies, SURVEY.md §3.1 hot loop).
+"""
+
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec
+
+
+class TensorValue:
+    """Immutable record of named host tensors.
+
+    Fields are numpy arrays; arbitrary picklable metadata rides along (e.g.
+    a record id or label string) without entering the device path.
+    """
+
+    __slots__ = ("_fields", "_meta")
+
+    def __init__(
+        self,
+        fields: typing.Mapping[str, typing.Any],
+        meta: typing.Optional[typing.Mapping[str, typing.Any]] = None,
+    ):
+        frozen = {}
+        for name, arr in fields.items():
+            a = np.asarray(arr)
+            a.setflags(write=False)
+            frozen[name] = a
+        object.__setattr__(self, "_fields", frozen)
+        object.__setattr__(self, "_meta", dict(meta or {}))
+
+    # -- immutability ------------------------------------------------------
+    def __setattr__(self, name, value):
+        raise AttributeError("TensorValue is immutable")
+
+    # -- access ------------------------------------------------------------
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._fields[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._fields
+
+    @property
+    def fields(self) -> typing.Mapping[str, np.ndarray]:
+        return self._fields
+
+    @property
+    def meta(self) -> typing.Mapping[str, typing.Any]:
+        return self._meta
+
+    @property
+    def names(self) -> typing.List[str]:
+        return list(self._fields.keys())
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{k}: {v.shape}/{v.dtype}" for k, v in self._fields.items()
+        )
+        return f"TensorValue({inner})"
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, TensorValue):
+            return NotImplemented
+        if set(self._fields) != set(other._fields) or self._meta != other._meta:
+            return False
+        return all(np.array_equal(self._fields[k], other._fields[k]) for k in self._fields)
+
+    # -- derivation --------------------------------------------------------
+    def replace(self, **fields) -> "TensorValue":
+        merged = dict(self._fields)
+        merged.update(fields)
+        return TensorValue(merged, self._meta)
+
+    def with_meta(self, **meta) -> "TensorValue":
+        merged = dict(self._meta)
+        merged.update(meta)
+        return TensorValue(self._fields, merged)
+
+    def select(self, *names: str) -> "TensorValue":
+        return TensorValue({n: self._fields[n] for n in names}, self._meta)
+
+    # -- schema ------------------------------------------------------------
+    def schema(self) -> RecordSchema:
+        return RecordSchema(
+            {n: TensorSpec(a.shape, a.dtype) for n, a in self._fields.items()}
+        )
+
+    def conforms_to(self, schema: RecordSchema) -> bool:
+        try:
+            schema.validate(self._fields)
+            return True
+        except TypeError:
+            return False
+
+    # -- serialization (crosses channels / checkpoints) -------------------
+    def __getstate__(self):
+        return {"fields": dict(self._fields), "meta": self._meta}
+
+    def __setstate__(self, state):
+        frozen = {}
+        for name, arr in state["fields"].items():
+            a = np.asarray(arr)
+            a.setflags(write=False)
+            frozen[name] = a
+        object.__setattr__(self, "_fields", frozen)
+        object.__setattr__(self, "_meta", dict(state["meta"]))
+
+    # -- device boundary ---------------------------------------------------
+    def to_device(self, device=None) -> typing.Dict[str, typing.Any]:
+        """Transfer all fields to a device as ``jax.Array``s.
+
+        Prefer batching first (tensors.batching) — per-record transfers are
+        the anti-pattern this framework exists to remove.
+        """
+        import jax
+
+        return {n: jax.device_put(a, device) for n, a in self._fields.items()}
+
+    @staticmethod
+    def from_device(arrays: typing.Mapping[str, typing.Any], meta=None) -> "TensorValue":
+        """Bring device arrays back to a host record (blocks on transfer)."""
+        return TensorValue({n: np.asarray(a) for n, a in arrays.items()}, meta)

@@ -1,0 +1,151 @@
+"""Tensor record layer tests — the reference's round-trip record<->tensor
+and serializer tests reimagined for the pytree record design (SURVEY.md §4:
+"unit tests ... covering the tensor wrapper (round-trip record<->tensor,
+serializer correctness)")."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu.tensors import (
+    BucketLadder,
+    BucketPolicy,
+    RecordSchema,
+    TensorSpec,
+    TensorValue,
+    assemble,
+    coerce,
+    image_to_float,
+    spec,
+)
+
+
+class TestTensorSpec:
+    def test_validate_static(self):
+        s = spec((3, 4), np.float32)
+        s.validate(np.zeros((3, 4), np.float32))
+        with pytest.raises(TypeError):
+            s.validate(np.zeros((3, 5), np.float32))
+        with pytest.raises(TypeError):
+            s.validate(np.zeros((3, 4), np.float64))
+
+    def test_dynamic_dim(self):
+        s = spec((None, 8))
+        assert not s.is_static
+        s.validate(np.zeros((17, 8), np.float32))
+        with pytest.raises(ValueError):
+            s.with_batch(4)
+
+    def test_batched_struct(self):
+        schema = RecordSchema({"x": spec((28, 28, 1))})
+        structs = schema.batched_struct(32)
+        assert structs["x"].shape == (32, 28, 28, 1)
+
+
+class TestTensorValue:
+    def test_immutable(self):
+        v = TensorValue({"x": np.arange(3)})
+        with pytest.raises(AttributeError):
+            v.x = 1
+        with pytest.raises(ValueError):
+            v["x"][0] = 99  # buffers are frozen
+
+    def test_pickle_roundtrip(self):
+        v = TensorValue({"x": np.arange(3.0)}, meta={"id": 7})
+        w = pickle.loads(pickle.dumps(v))
+        assert w == v and w.meta["id"] == 7
+
+    def test_replace_and_meta(self):
+        v = TensorValue({"x": np.zeros(2)})
+        w = v.replace(x=np.ones(2)).with_meta(tag="a")
+        assert np.array_equal(w["x"], np.ones(2)) and w.meta["tag"] == "a"
+        assert np.array_equal(v["x"], np.zeros(2))  # original untouched
+
+    def test_device_roundtrip(self):
+        v = TensorValue({"x": np.arange(4.0, dtype=np.float32)})
+        dev = v.to_device()
+        w = TensorValue.from_device(dev, meta=v.meta)
+        assert w == v
+
+
+class TestCoercion:
+    def test_row_mapping(self):
+        schema = RecordSchema({"a": spec((2,)), "b": spec((), np.int32)})
+        v = coerce({"a": [1.0, 2.0], "b": 3}, schema)
+        assert v["a"].dtype == np.float32 and v["b"].dtype == np.int32
+
+    def test_row_tuple_by_position(self):
+        schema = RecordSchema({"a": spec((2,)), "b": spec((), np.int32)})
+        v = coerce(([1.0, 2.0], 3), schema)
+        assert np.array_equal(v["a"], [1.0, 2.0])
+
+    def test_bare_array_single_field(self):
+        schema = RecordSchema({"image": spec((2, 2, 3), np.uint8)})
+        v = coerce(np.zeros((2, 2, 3), np.uint8), schema)
+        assert v["image"].shape == (2, 2, 3)
+
+    def test_mismatch_raises(self):
+        schema = RecordSchema({"a": spec((2,))})
+        with pytest.raises(TypeError):
+            coerce({"b": [1.0]}, schema)
+
+    def test_image_to_float(self):
+        img = np.full((4, 4, 3), 255, np.uint8)
+        out = image_to_float(img, scale=2.0 / 255.0, offset=-1.0)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, 1.0)
+
+
+class TestBatching:
+    def test_bucket_ladder(self):
+        ladder = BucketLadder(max_size=64)
+        assert ladder.round_up(1) == 1
+        assert ladder.round_up(3) == 4
+        assert ladder.round_up(64) == 64
+        with pytest.raises(ValueError):
+            ladder.round_up(65)
+
+    def test_assemble_static(self):
+        schema = RecordSchema({"x": spec((3,))})
+        records = [TensorValue({"x": np.full(3, i, np.float32)}, {"i": i}) for i in range(5)]
+        batch = assemble(records, schema)
+        assert batch.padded_size == 8 and batch.num_records == 5
+        assert batch.arrays["x"].shape == (8, 3)
+        assert batch.valid.tolist() == [True] * 5 + [False] * 3
+        # pad rows replay record 0
+        np.testing.assert_array_equal(batch.arrays["x"][5], batch.arrays["x"][0])
+
+    def test_assemble_dynamic_lengths(self):
+        schema = RecordSchema({"tokens": TensorSpec((None,), np.int32)})
+        records = [
+            TensorValue({"tokens": np.arange(n, dtype=np.int32)}, {"n": n})
+            for n in (3, 7, 5)
+        ]
+        batch = assemble(records, schema, BucketPolicy(lengths=BucketLadder(max_size=64)))
+        assert batch.arrays["tokens"].shape == (4, 8)  # len 7 -> bucket 8, batch 3 -> 4
+        assert batch.lengths["tokens"][:3].tolist() == [3, 7, 5]
+        np.testing.assert_array_equal(batch.arrays["tokens"][1][:7], np.arange(7))
+        assert batch.arrays["tokens"][1][7] == 0  # length pad is zero
+
+    def test_unbatch_drops_padding_and_restores_meta(self):
+        schema = RecordSchema({"x": spec((2,))})
+        records = [TensorValue({"x": np.full(2, i, np.float32)}, {"i": i}) for i in range(3)]
+        batch = assemble(records, schema)
+        outputs = {"y": batch.arrays["x"] * 10}
+        out_records = batch.unbatch(outputs)
+        assert len(out_records) == 3
+        assert [r.meta["i"] for r in out_records] == [0, 1, 2]
+        np.testing.assert_array_equal(out_records[2]["y"], [20.0, 20.0])
+
+    def test_fixed_batch_policy(self):
+        schema = RecordSchema({"x": spec(())})
+        records = [TensorValue({"x": np.float32(i)}) for i in range(3)]
+        batch = assemble(records, schema, BucketPolicy(fixed_batch=16))
+        assert batch.padded_size == 16
+
+    def test_bucket_key_stable(self):
+        schema = RecordSchema({"x": spec((3,))})
+        b1 = assemble([TensorValue({"x": np.zeros(3, np.float32)})] * 3, schema)
+        b2 = assemble([TensorValue({"x": np.ones(3, np.float32)})] * 4, schema)
+        assert b1.bucket_key() == b2.bucket_key()  # both pad to bucket 4
