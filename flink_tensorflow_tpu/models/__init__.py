@@ -1,0 +1,26 @@
+"""Model layer — abstraction, loaders, and the workload zoo.
+
+TPU-native replacement for the reference's model-loading path
+(``GraphLoader``/``SavedModelLoader`` + ``Model``/``GraphMethod``,
+SURVEY.md §2 rows 4-6, BASELINE.json:5).
+"""
+
+from flink_tensorflow_tpu.models.base import Model, ModelMethod
+from flink_tensorflow_tpu.models.loaders import (
+    GraphLoader,
+    SavedModelLoader,
+    freeze_method,
+    save_bundle,
+)
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, get_model_def
+
+__all__ = [
+    "GraphLoader",
+    "Model",
+    "ModelDef",
+    "ModelMethod",
+    "SavedModelLoader",
+    "freeze_method",
+    "get_model_def",
+    "save_bundle",
+]

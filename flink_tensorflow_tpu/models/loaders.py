@@ -1,0 +1,161 @@
+"""Model loaders — GraphLoader / SavedModelLoader, TPU-native.
+
+The reference names these two loaders as load-bearing (BASELINE.json:5;
+SURVEY.md §2 rows 4-5): ``GraphLoader`` imports a frozen ``GraphDef`` into
+a TF Graph + Session; ``SavedModelLoader`` loads a SavedModel bundle by
+tags and resolves ``SignatureDef``s.  The TPU equivalents:
+
+- :class:`GraphLoader` — loads a **frozen function**: a jax-exported
+  StableHLO artifact (``jax.export`` serialization).  Like a GraphDef it
+  is self-contained (weights baked in), architecture-anonymous, and
+  executable without the defining Python code.  ``load()`` -> a callable
+  XLA executable; "import into a Graph" becomes "deserialize + compile".
+- :class:`SavedModelLoader` — loads a **model bundle** directory:
+  ``model.json`` (architecture + config — the MetaGraphDef analogue) plus
+  ``params.msgpack`` (flax-serialized variables — the variables/ dir
+  analogue).  Signatures come back as typed :class:`ModelMethod`s.
+
+Both run in the operator ``open()`` slot (SURVEY.md §3.3): load -> compile
+once per subtask replica, release in ``close()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import typing
+
+from flink_tensorflow_tpu.models.base import Model
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, get_model_def
+
+BUNDLE_MANIFEST = "model.json"
+BUNDLE_PARAMS = "params.msgpack"
+BUNDLE_FORMAT = "flink-tensorflow-tpu-bundle"
+
+
+# ---------------------------------------------------------------------------
+# SavedModel-equivalent bundles
+# ---------------------------------------------------------------------------
+
+def save_bundle(model_def: ModelDef, params, path: str) -> None:
+    """Write a loadable bundle (the SavedModel-export analogue)."""
+    import flax.serialization
+
+    os.makedirs(path, exist_ok=True)
+    manifest = {
+        "format": BUNDLE_FORMAT,
+        "version": 1,
+        "architecture": model_def.architecture,
+        "config": model_def.config,
+    }
+    with open(os.path.join(path, BUNDLE_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+    with open(os.path.join(path, BUNDLE_PARAMS), "wb") as f:
+        f.write(flax.serialization.to_bytes(params))
+
+
+class SavedModelLoader:
+    """Loads a model bundle directory into a :class:`Model`.
+
+    ``method`` selects the signature (reference: SignatureDef name;
+    default "serve").  The architecture is rebuilt from the zoo registry
+    and restored params are attached — the whole bundle stays host-side
+    until an operator places it on device at ``open()``.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def manifest(self) -> dict:
+        with open(os.path.join(self.path, BUNDLE_MANIFEST)) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != BUNDLE_FORMAT:
+            raise ValueError(f"{self.path} is not a {BUNDLE_FORMAT} bundle")
+        return manifest
+
+    def model_def(self) -> ModelDef:
+        manifest = self.manifest()
+        return get_model_def(manifest["architecture"], **manifest["config"])
+
+    def load(self) -> Model:
+        import flax.serialization
+        import jax
+
+        model_def = self.model_def()
+        # Template pytree for typed deserialization (shapes/dtypes from init,
+        # no FLOPs spent: eval_shape traces without executing).
+        import numpy as np
+
+        structs = jax.eval_shape(model_def.init_params, jax.random.key(0))
+        template = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), structs)
+        with open(os.path.join(self.path, BUNDLE_PARAMS), "rb") as f:
+            params = flax.serialization.from_bytes(template, f.read())
+        return model_def.to_model(params)
+
+
+# ---------------------------------------------------------------------------
+# Frozen-function graphs (GraphDef analogue)
+# ---------------------------------------------------------------------------
+
+def freeze_method(model: Model, method_name: str = "serve", *, batch: int = 1,
+                  length_bucket: int = 128) -> bytes:
+    """Export one model method with params baked in -> serialized StableHLO.
+
+    The frozen artifact is specialized to one batch bucket, exactly as a
+    frozen GraphDef is specialized to its placeholder shapes.
+    """
+    import jax
+    from jax import export as jax_export
+
+    method = model.method(method_name)
+    params = model.params
+
+    if method.needs_lengths:
+        def frozen(inputs, lengths):
+            return method.fn(params, inputs, lengths)
+
+        example = _example_inputs(method.input_schema, batch, length_bucket)
+        lengths = {
+            n: jax.ShapeDtypeStruct((batch,), "int32")
+            for n, s in method.input_schema if not s.is_static
+        }
+        exported = jax_export.export(jax.jit(frozen))(example, lengths)
+    else:
+        def frozen(inputs):
+            return method.fn(params, inputs)
+
+        example = _example_inputs(method.input_schema, batch, length_bucket)
+        exported = jax_export.export(jax.jit(frozen))(example)
+    return exported.serialize()
+
+
+def _example_inputs(schema, batch: int, length_bucket: int):
+    import jax
+
+    out = {}
+    for name, spec in schema:
+        shape = tuple(length_bucket if d is None else d for d in spec.shape)
+        out[name] = jax.ShapeDtypeStruct((batch, *shape), spec.dtype)
+    return out
+
+
+class GraphLoader:
+    """Loads a frozen function (serialized jax export) into a callable.
+
+    Reference parity: ``GraphLoader.load()`` imported GraphDef bytes and
+    opened a Session; here ``load()`` deserializes StableHLO and returns
+    the compiled callable — weights inside, no Python model code needed.
+    """
+
+    def __init__(self, source: typing.Union[str, bytes]):
+        self.source = source
+
+    def load(self) -> typing.Callable:
+        from jax import export as jax_export
+
+        data = self.source
+        if isinstance(data, str):
+            with open(data, "rb") as f:
+                data = f.read()
+        exported = jax_export.deserialize(data)
+        return exported.call

@@ -1,0 +1,19 @@
+"""Model zoo — jax/flax-native definitions of the reference workloads' models.
+
+The reference ships no model code: it loads frozen TF graphs (Inception-v3
+from the TF model zoo, etc.) into embedded sessions.  A TPU-native rebuild
+cannot execute those CUDA-era GraphDefs; per SURVEY.md §7 hard part 1, the
+idiomatic equivalent is native jax/flax definitions of the same
+architectures with weight import from checkpoints — capability parity is
+behavioral, not mechanism parity.  One module per BASELINE.json workload:
+
+- :mod:`lenet`     — MNIST LeNet (BASELINE.json:8)
+- :mod:`inception` — Inception-v3 (BASELINE.json:7, the north-star model)
+- :mod:`resnet`    — ResNet-50 (BASELINE.json:11, DP training)
+- :mod:`bilstm`    — BiLSTM text classifier (BASELINE.json:9)
+- :mod:`widedeep`  — Wide&Deep recommender (BASELINE.json:10)
+"""
+
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, get_model_def, register_model_def
+
+__all__ = ["ModelDef", "get_model_def", "register_model_def"]

@@ -1,0 +1,96 @@
+"""BiLSTM text classifier — streaming inference with dynamic batching
+(BASELINE.json:9).
+
+Variable-length token sequences are the one dynamic-shape workload in the
+reference's set.  TPU-native handling (SURVEY.md §7 hard part 2): the
+stream layer buckets lengths (tensors.batching), so this module always
+sees a static ``[B, T_bucket]`` — true lengths arrive as a ``[B]`` vector
+and drive masking, not shapes.  The recurrence is a ``lax.scan`` under the
+hood (flax ``nn.RNN``), which XLA unrolls into a single fused loop on
+device — the idiomatic replacement for TF's ``dynamic_rnn`` while-loop
+graph the reference would execute.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tensorflow_tpu.models.base import ModelMethod
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, register_model_def
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec, spec
+
+
+class BiLSTMClassifier(nn.Module):
+    vocab_size: int = 20000
+    embed_dim: int = 128
+    hidden_dim: int = 256
+    num_classes: int = 2
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, lengths):
+        # Embedding lookups are gathers (HBM-bound); keep the table bf16.
+        emb = nn.Embed(self.vocab_size, self.embed_dim,
+                       dtype=self.compute_dtype)(tokens)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.compute_dtype),
+                     return_carry=True)
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.compute_dtype),
+                     return_carry=True, reverse=True, keep_order=True)
+        (_, h_fwd), _ = fwd(emb, seq_lengths=lengths)
+        (_, h_bwd), _ = bwd(emb, seq_lengths=lengths)
+        h = jnp.concatenate([h_fwd, h_bwd], axis=-1)
+        h = nn.relu(nn.Dense(self.hidden_dim, dtype=self.compute_dtype)(h))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(h)
+
+
+@register_model_def("bilstm")
+def build(vocab_size: int = 20000, embed_dim: int = 128, hidden_dim: int = 256,
+          num_classes: int = 2) -> ModelDef:
+    module = BiLSTMClassifier(vocab_size=vocab_size, embed_dim=embed_dim,
+                              hidden_dim=hidden_dim, num_classes=num_classes)
+    # Dynamic sequence axis: resolved to a length bucket by the batcher.
+    schema = RecordSchema({"tokens": TensorSpec((None,), np.int32)})
+
+    def serve(variables, inputs, lengths):
+        logits = module.apply(variables, inputs["tokens"], lengths["tokens"])
+        return {
+            "logits": logits,
+            "label": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            "prob": jax.nn.softmax(logits, axis=-1),
+        }
+
+    def init_fn(rng):
+        return module.init(rng, jnp.zeros((1, 8), jnp.int32), jnp.full((1,), 8, jnp.int32))
+
+    def loss_fn(variables, batch, rng):
+        import optax
+
+        logits = module.apply(variables, batch["tokens"], batch["tokens_len"])
+        labels = batch["label"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, ({}, {"loss": loss, "accuracy": acc})
+
+    methods = {
+        "serve": ModelMethod(
+            name="serve",
+            input_schema=schema,
+            output_names=("logits", "label", "prob"),
+            fn=serve,
+            needs_lengths=True,
+            compute_dtype=jnp.bfloat16,
+        )
+    }
+    return ModelDef(
+        architecture="bilstm",
+        config={"vocab_size": vocab_size, "embed_dim": embed_dim,
+                "hidden_dim": hidden_dim, "num_classes": num_classes},
+        module=module,
+        input_schema=schema,
+        methods=methods,
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+    )

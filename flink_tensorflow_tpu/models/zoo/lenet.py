@@ -1,0 +1,92 @@
+"""LeNet-5 for the MNIST windowed micro-batch workload (BASELINE.json:8).
+
+The reference runs a frozen MNIST LeNet graph inside a windowed
+ProcessFunction ("count-window micro-batch").  This is the native flax
+definition; weights can be imported from a TF checkpoint via
+models.import_tf (gated on TF availability) or trained from scratch in
+minutes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tensorflow_tpu.models.base import ModelMethod
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, register_model_def
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, spec
+
+
+class LeNet(nn.Module):
+    """Classic LeNet-5, NHWC.  Tiny, but still routed through the MXU:
+    convs are lowered to matmuls by XLA, and the micro-batch dim keeps
+    them fat enough to tile."""
+
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.compute_dtype)(x))
+        # Logits in float32: cheap, and keeps softmax numerics stable.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register_model_def("lenet")
+def build(num_classes: int = 10, image_size: int = 28, channels: int = 1) -> ModelDef:
+    module = LeNet(num_classes=num_classes)
+    schema = RecordSchema({"image": spec((image_size, image_size, channels), np.float32)})
+
+    def serve(variables, inputs):
+        logits = module.apply(variables, inputs["image"])
+        return {
+            "logits": logits,
+            "label": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            "prob": jax.nn.softmax(logits, axis=-1),
+        }
+
+    def init_fn(rng):
+        return module.init(rng, jnp.zeros((1, image_size, image_size, channels)))
+
+    def loss_fn(variables, batch, rng):
+        logits = module.apply(variables, batch["image"])
+        labels = batch["label"]
+        loss = optax_softmax_ce(logits, labels)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, ({}, {"loss": loss, "accuracy": acc})
+
+    methods = {
+        "serve": ModelMethod(
+            name="serve",
+            input_schema=schema,
+            output_names=("logits", "label", "prob"),
+            fn=serve,
+            compute_dtype=jnp.bfloat16,
+        )
+    }
+    return ModelDef(
+        architecture="lenet",
+        config={"num_classes": num_classes, "image_size": image_size, "channels": channels},
+        module=module,
+        input_schema=schema,
+        methods=methods,
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+    )
+
+
+def optax_softmax_ce(logits, labels):
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

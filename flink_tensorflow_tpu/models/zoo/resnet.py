@@ -1,0 +1,124 @@
+"""ResNet-50 for the data-parallel training workload (BASELINE.json:11).
+
+The reference trains ResNet-50 data-parallel across TaskManagers with TF
+ClusterSpec + NCCL allreduce; here the same architecture is a flax module
+whose train step is ``pjit``-ed over a ``{data}`` mesh — the allreduce is
+an XLA collective over ICI, emitted by the compiler from the sharding
+annotations, with no communication code in the model (SURVEY.md §3.5).
+
+NHWC + bfloat16 compute keeps convs on the MXU; batch-norm statistics are
+accumulated in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tensorflow_tpu.models.base import ModelMethod
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, register_model_def
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, spec
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: typing.Tuple[int, int] = (1, 1)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9, dtype=self.compute_dtype
+        )
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=self.strides, padding="SAME")(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), strides=self.strides)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: typing.Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(self.width * 2**i, strides=strides,
+                                    compute_dtype=self.compute_dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register_model_def("resnet50")
+def build(num_classes: int = 1000, image_size: int = 224, width: int = 64,
+          stage_sizes: typing.Tuple[int, ...] = (3, 4, 6, 3)) -> ModelDef:
+    module = ResNet(stage_sizes=tuple(stage_sizes), num_classes=num_classes, width=width)
+    schema = RecordSchema({"image": spec((image_size, image_size, 3), np.float32)})
+
+    def serve(variables, inputs):
+        logits = module.apply(variables, inputs["image"], train=False)
+        return {
+            "logits": logits,
+            "label": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            "prob": jax.nn.softmax(logits, axis=-1),
+        }
+
+    def init_fn(rng):
+        return module.init(rng, jnp.zeros((1, image_size, image_size, 3)), train=False)
+
+    def loss_fn(variables, batch, rng):
+        import optax
+
+        params = {k: v for k, v in variables.items() if k != "batch_stats"}
+        logits, new_state = module.apply(
+            {**params, "batch_stats": variables["batch_stats"]},
+            batch["image"], train=True, mutable=["batch_stats"],
+        )
+        labels = batch["label"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, (new_state, {"loss": loss, "accuracy": acc})
+
+    methods = {
+        "serve": ModelMethod(
+            name="serve",
+            input_schema=schema,
+            output_names=("logits", "label", "prob"),
+            fn=serve,
+            compute_dtype=jnp.bfloat16,
+        )
+    }
+    return ModelDef(
+        architecture="resnet50",
+        config={"num_classes": num_classes, "image_size": image_size, "width": width,
+                "stage_sizes": list(stage_sizes)},
+        module=module,
+        input_schema=schema,
+        methods=methods,
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+    )

@@ -1,0 +1,104 @@
+"""Wide&Deep recommender — the per-key online-training workload
+(BASELINE.json:10: "keyed stream, per-key SGD step").
+
+Wide part: a linear model over (pre-crossed) sparse features, delivered as
+a multi-hot float vector.  Deep part: hashed categorical ids -> shared
+embedding table -> MLP over [embeddings ++ dense features].  Binary logit
+= wide + deep (Cheng et al. 2016).
+
+Online SGD runs as a keyed stream operator whose state IS the params
+pytree (SURVEY.md §3.4: the reference keeps variables inside the TF
+session; here they are explicit operator state, so checkpoint barriers
+snapshot them natively — SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tensorflow_tpu.models.base import ModelMethod
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef, register_model_def
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, spec
+
+
+class WideDeep(nn.Module):
+    hash_buckets: int = 100_000
+    embed_dim: int = 32
+    num_cat_slots: int = 8
+    num_dense: int = 13
+    num_wide: int = 64
+    hidden: tuple = (256, 128, 64)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, wide, dense, cat):
+        # Wide: linear over crossed features (float32 — it's one dot).
+        wide_logit = nn.Dense(1, dtype=jnp.float32, name="wide")(wide)[..., 0]
+        # Deep: shared hashed embedding table + MLP.
+        emb = nn.Embed(self.hash_buckets, self.embed_dim,
+                       dtype=self.compute_dtype, name="embed")(cat)
+        x = jnp.concatenate(
+            [emb.reshape((emb.shape[0], -1)), dense.astype(self.compute_dtype)], axis=-1
+        )
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.compute_dtype)(x))
+        deep_logit = nn.Dense(1, dtype=jnp.float32)(x)[..., 0]
+        return wide_logit + deep_logit
+
+
+@register_model_def("widedeep")
+def build(hash_buckets: int = 100_000, embed_dim: int = 32, num_cat_slots: int = 8,
+          num_dense: int = 13, num_wide: int = 64, hidden=(256, 128, 64)) -> ModelDef:
+    module = WideDeep(hash_buckets=hash_buckets, embed_dim=embed_dim,
+                      num_cat_slots=num_cat_slots, num_dense=num_dense,
+                      num_wide=num_wide, hidden=tuple(hidden))
+    schema = RecordSchema({
+        "wide": spec((num_wide,), np.float32),
+        "dense": spec((num_dense,), np.float32),
+        "cat": spec((num_cat_slots,), np.int32),
+    })
+
+    def serve(variables, inputs):
+        logit = module.apply(variables, inputs["wide"], inputs["dense"], inputs["cat"])
+        return {"logit": logit, "prob": jax.nn.sigmoid(logit)}
+
+    def init_fn(rng):
+        return module.init(
+            rng,
+            jnp.zeros((1, num_wide)),
+            jnp.zeros((1, num_dense)),
+            jnp.zeros((1, num_cat_slots), jnp.int32),
+        )
+
+    def loss_fn(variables, batch, rng):
+        import optax
+
+        logit = module.apply(variables, batch["wide"], batch["dense"], batch["cat"])
+        label = batch["label"].astype(jnp.float32)
+        loss = optax.sigmoid_binary_cross_entropy(logit, label).mean()
+        acc = jnp.mean(((logit > 0) == (label > 0.5)).astype(jnp.float32))
+        return loss, ({}, {"loss": loss, "accuracy": acc})
+
+    methods = {
+        "serve": ModelMethod(
+            name="serve",
+            input_schema=schema,
+            output_names=("logit", "prob"),
+            fn=serve,
+            compute_dtype=jnp.bfloat16,
+        )
+    }
+    return ModelDef(
+        architecture="widedeep",
+        config={"hash_buckets": hash_buckets, "embed_dim": embed_dim,
+                "num_cat_slots": num_cat_slots, "num_dense": num_dense,
+                "num_wide": num_wide, "hidden": list(hidden)},
+        module=module,
+        input_schema=schema,
+        methods=methods,
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+    )

@@ -130,6 +130,10 @@ def assemble(
     policy = policy or BucketPolicy()
     n = len(records)
     b = policy.batch_bucket(n)
+    if b < n:
+        raise ValueError(
+            f"{n} records exceed fixed_batch={b}; chunk the window upstream"
+        )
 
     arrays: typing.Dict[str, np.ndarray] = {}
     lengths: typing.Dict[str, np.ndarray] = {}
@@ -152,11 +156,14 @@ def assemble(
                 padded[n:] = padded[0]
             arrays[name] = padded
         else:
-            stacked = np.stack(parts).astype(spec.dtype, copy=False)
-            if b > n:
-                pad = np.broadcast_to(stacked[0], (b - n, *stacked.shape[1:]))
-                stacked = np.concatenate([stacked, pad], axis=0)
-            arrays[name] = np.ascontiguousarray(stacked)
+            # Single preallocated contiguous buffer, one row-copy per record
+            # — this fill IS the batch's host-side memory traffic, keep it 1x.
+            out = np.empty((b, *parts[0].shape), dtype=spec.dtype)
+            for i, p in enumerate(parts):
+                out[i] = p
+            if b > n:  # batch pad replays record 0
+                out[n:] = out[0]
+            arrays[name] = out
 
     valid = np.zeros((b,), dtype=bool)
     valid[:n] = True

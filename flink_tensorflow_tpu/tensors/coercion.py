@@ -66,9 +66,12 @@ def coerce(value: typing.Any, schema: RecordSchema) -> TensorValue:
     - single array-like, when the schema has exactly one field
     """
     if isinstance(value, TensorValue):
-        fields = {n: value[n] for n in schema.names}
-        out = TensorValue({n: coerce_field(a, schema[n]) for n, a in fields.items()}, value.meta)
-        return out
+        missing = set(schema.names) - set(value.names)
+        if missing:
+            raise TypeError(f"record missing fields {missing}")
+        return TensorValue(
+            {n: coerce_field(value[n], schema[n]) for n in schema.names}, value.meta
+        )
     if isinstance(value, typing.Mapping):
         missing = set(schema.names) - set(value)
         if missing:

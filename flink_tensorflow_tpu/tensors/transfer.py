@@ -31,11 +31,13 @@ class DeviceTransfer:
         self.device = device
 
     def to_device(self, batch: Batch) -> typing.Dict[str, typing.Any]:
-        """Ship all batch fields to HBM in one transfer."""
+        """Ship all batch fields to HBM in one transfer.
+
+        ``device_put`` on the whole pytree dispatches one transfer; None
+        means jit-default placement.
+        """
         import jax
 
-        if self.device is None:
-            return {n: jax.device_put(a) for n, a in batch.arrays.items()}
         return jax.device_put(batch.arrays, self.device)
 
     def lengths_to_device(self, batch: Batch) -> typing.Dict[str, typing.Any]:
@@ -43,8 +45,6 @@ class DeviceTransfer:
 
         if not batch.lengths:
             return {}
-        if self.device is None:
-            return {n: jax.device_put(a) for n, a in batch.lengths.items()}
         return jax.device_put(batch.lengths, self.device)
 
     @staticmethod

@@ -36,7 +36,12 @@ class TensorValue:
         frozen = {}
         for name, arr in fields.items():
             a = np.asarray(arr)
-            a.setflags(write=False)
+            # Detach from the caller's buffer: freezing the caller's own
+            # array in place (or aliasing a writable view) would leak
+            # mutability in or out of the record.
+            if a.flags.writeable:
+                a = a.copy()
+                a.setflags(write=False)
             frozen[name] = a
         object.__setattr__(self, "_fields", frozen)
         object.__setattr__(self, "_meta", dict(meta or {}))
@@ -111,6 +116,7 @@ class TensorValue:
     def __setstate__(self, state):
         frozen = {}
         for name, arr in state["fields"].items():
+            # Unpickled arrays are freshly allocated — no aliasing, no copy.
             a = np.asarray(arr)
             a.setflags(write=False)
             frozen[name] = a

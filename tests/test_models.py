@@ -1,0 +1,140 @@
+"""Model layer tests: zoo forward passes, loader round-trips, frozen
+functions — the reference's loader-behavior unit tests (SURVEY.md §4)
+recast for bundles and jax-export artifacts.
+
+Everything is jitted: eager per-op dispatch is pathologically slow in this
+environment, and the framework's production path is always-compiled anyway
+(the model runner jits per batch bucket)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_tensorflow_tpu.models import (
+    GraphLoader,
+    SavedModelLoader,
+    freeze_method,
+    get_model_def,
+    save_bundle,
+)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return jax.random.key(0)
+
+
+def init_jit(mdef, rng):
+    return jax.jit(mdef.init_fn)(rng)
+
+
+class TestZoo:
+    def test_lenet_serve(self, rng):
+        mdef = get_model_def("lenet")
+        params = init_jit(mdef, rng)
+        out = jax.jit(mdef.methods["serve"].fn)(params, {"image": jnp.zeros((4, 28, 28, 1))})
+        assert out["logits"].shape == (4, 10)
+        assert out["label"].shape == (4,) and out["label"].dtype == jnp.int32
+        np.testing.assert_allclose(np.sum(np.asarray(out["prob"]), -1), 1.0, rtol=1e-3)
+
+    def test_resnet_tiny_serve_and_loss(self, rng):
+        mdef = get_model_def("resnet50", num_classes=7, image_size=32, width=8,
+                             stage_sizes=(1, 1))
+        params = init_jit(mdef, rng)
+        out = jax.jit(mdef.methods["serve"].fn)(params, {"image": jnp.zeros((2, 32, 32, 3))})
+        assert out["logits"].shape == (2, 7)
+        batch = {"image": jnp.zeros((2, 32, 32, 3)),
+                 "label": jnp.array([1, 2], jnp.int32)}
+        loss, (new_state, metrics) = jax.jit(mdef.loss_fn)(params, batch, rng)
+        assert np.isfinite(float(loss)) and "batch_stats" in new_state
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+    def test_inception_v3_serve(self, rng):
+        mdef = get_model_def("inception_v3", num_classes=10)
+        params = init_jit(mdef, rng)
+        out = jax.jit(mdef.methods["serve"].fn)(
+            params, {"image": jnp.zeros((1, 299, 299, 3))}
+        )
+        assert out["logits"].shape == (1, 10)
+        assert float(out["score"][0]) <= 1.0
+
+    def test_bilstm_padding_invariance(self, rng):
+        """Same sequence padded to different buckets -> same logits: the
+        masking contract dynamic batching relies on (BASELINE.json:9)."""
+        mdef = get_model_def("bilstm", vocab_size=50, hidden_dim=16, embed_dim=8)
+        params = init_jit(mdef, rng)
+        tokens = np.array([3, 7, 11, 2], np.int32)
+        fn = jax.jit(mdef.methods["serve"].fn)
+        out8 = fn(params,
+                  {"tokens": jnp.asarray(np.pad(tokens, (0, 4))[None])},
+                  {"tokens": jnp.array([4], jnp.int32)})
+        out16 = fn(params,
+                   {"tokens": jnp.asarray(np.pad(tokens, (0, 12))[None])},
+                   {"tokens": jnp.array([4], jnp.int32)})
+        np.testing.assert_allclose(np.asarray(out8["logits"]),
+                                   np.asarray(out16["logits"]), atol=2e-2)
+
+    def test_widedeep_serve_and_loss(self, rng):
+        mdef = get_model_def("widedeep", hash_buckets=100, embed_dim=4,
+                             hidden=(16, 8))
+        params = init_jit(mdef, rng)
+        inputs = {
+            "wide": jnp.ones((3, 64)),
+            "dense": jnp.ones((3, 13)),
+            "cat": jnp.zeros((3, 8), jnp.int32),
+        }
+        out = jax.jit(mdef.methods["serve"].fn)(params, inputs)
+        assert out["prob"].shape == (3,)
+        batch = dict(inputs, label=jnp.array([0, 1, 1], jnp.int32))
+        loss, (_, metrics) = jax.jit(mdef.loss_fn)(params, batch, rng)
+        assert np.isfinite(float(loss))
+
+    def test_unknown_architecture(self):
+        with pytest.raises(KeyError):
+            get_model_def("alexnet")
+
+
+class TestLoaders:
+    def test_bundle_roundtrip(self, rng, tmp_path):
+        mdef = get_model_def("lenet")
+        params = init_jit(mdef, rng)
+        path = str(tmp_path / "lenet_bundle")
+        save_bundle(mdef, params, path)
+
+        model = SavedModelLoader(path).load()
+        assert model.metadata["architecture"] == "lenet"
+        x = {"image": jnp.ones((2, 28, 28, 1))}
+        serve = jax.jit(mdef.methods["serve"].fn)
+        want = serve(params, x)
+        got = serve(model.params, x)
+        np.testing.assert_allclose(np.asarray(want["logits"]),
+                                   np.asarray(got["logits"]), atol=1e-6)
+
+    def test_bundle_bad_format(self, tmp_path):
+        import json
+
+        (tmp_path / "model.json").write_text(json.dumps({"format": "other"}))
+        with pytest.raises(ValueError):
+            SavedModelLoader(str(tmp_path)).manifest()
+
+    def test_frozen_graph_roundtrip(self, rng, tmp_path):
+        mdef = get_model_def("lenet")
+        model = mdef.to_model(init_jit(mdef, rng))
+        frozen_bytes = freeze_method(model, "serve", batch=2)
+        path = tmp_path / "lenet.stablehlo"
+        path.write_bytes(frozen_bytes)
+
+        fn = GraphLoader(str(path)).load()
+        x = {"image": jnp.ones((2, 28, 28, 1))}
+        got = fn(x)
+        want = jax.jit(model.method("serve").fn)(model.params, x)
+        np.testing.assert_allclose(np.asarray(want["logits"]),
+                                   np.asarray(got["logits"]), atol=1e-6)
+
+    def test_missing_method(self, rng):
+        mdef = get_model_def("lenet")
+        model = mdef.to_model(init_jit(mdef, rng))
+        with pytest.raises(KeyError):
+            model.method("nope")

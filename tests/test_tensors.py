@@ -51,6 +51,12 @@ class TestTensorValue:
         with pytest.raises(ValueError):
             v["x"][0] = 99  # buffers are frozen
 
+    def test_does_not_freeze_or_alias_caller_buffer(self):
+        buf = np.zeros(3)
+        v = TensorValue({"x": buf})
+        buf[0] = 99  # caller's buffer stays writable...
+        assert v["x"][0] == 0  # ...and the record doesn't see the write
+
     def test_pickle_roundtrip(self):
         v = TensorValue({"x": np.arange(3.0)}, meta={"id": 7})
         w = pickle.loads(pickle.dumps(v))
@@ -89,6 +95,11 @@ class TestCoercion:
         schema = RecordSchema({"a": spec((2,))})
         with pytest.raises(TypeError):
             coerce({"b": [1.0]}, schema)
+
+    def test_tensorvalue_missing_field_raises_typeerror(self):
+        schema = RecordSchema({"a": spec((2,)), "b": spec((2,))})
+        with pytest.raises(TypeError):
+            coerce(TensorValue({"a": np.zeros(2, np.float32)}), schema)
 
     def test_image_to_float(self):
         img = np.full((4, 4, 3), 255, np.uint8)
@@ -143,6 +154,12 @@ class TestBatching:
         records = [TensorValue({"x": np.float32(i)}) for i in range(3)]
         batch = assemble(records, schema, BucketPolicy(fixed_batch=16))
         assert batch.padded_size == 16
+
+    def test_fixed_batch_overflow_raises(self):
+        schema = RecordSchema({"x": spec(())})
+        records = [TensorValue({"x": np.float32(i)}) for i in range(5)]
+        with pytest.raises(ValueError):
+            assemble(records, schema, BucketPolicy(fixed_batch=4))
 
     def test_bucket_key_stable(self):
         schema = RecordSchema({"x": spec((3,))})
