@@ -1,0 +1,18 @@
+"""Stream-operator model bridge — ModelFunction/GraphFunction equivalents
+(BASELINE.json:5; SURVEY.md §2 row 7)."""
+
+from flink_tensorflow_tpu.functions.model_function import (
+    GraphMapFunction,
+    GraphWindowFunction,
+    ModelMapFunction,
+    ModelWindowFunction,
+)
+from flink_tensorflow_tpu.functions.runner import CompiledMethodRunner
+
+__all__ = [
+    "CompiledMethodRunner",
+    "GraphMapFunction",
+    "GraphWindowFunction",
+    "ModelMapFunction",
+    "ModelWindowFunction",
+]

@@ -1,0 +1,191 @@
+"""ModelFunction / GraphFunction — models as stream operators.
+
+The reference's core bridge (BASELINE.json:5; SURVEY.md §2 row 7):
+``ModelFunction`` wraps a loaded model in a Flink rich function —
+``open()`` loads the model and opens a Session, ``map``/``process``
+invokes it, ``close()`` releases it.  Same lifecycle here, with the TF
+session replaced by a :class:`CompiledMethodRunner` (params in HBM + XLA
+executables per bucket):
+
+- :class:`ModelMapFunction` — per-record inference for ``stream.map``
+  (SURVEY.md §3.1).  Each record rides a batch-of-1 executable; for
+  throughput prefer the windowed form.
+- :class:`ModelWindowFunction` — micro-batch inference for
+  ``stream.count_window(B).apply(...)`` (SURVEY.md §3.2): the fired
+  window becomes ONE jitted call on a ``[B, ...]`` bucket.
+- :class:`GraphMapFunction` / :class:`GraphWindowFunction` — same two
+  modes over a **frozen function** (GraphLoader artifact, weights baked
+  in), for deployments that ship compiled artifacts instead of bundles.
+
+Model sources are lazy: pass a bundle path or a loader, and each subtask
+materializes its own replica at ``open()`` — operator parallelism N gives
+N independent model replicas, the reference's inference-DP story
+(SURVEY.md §2 "Parallelism strategies").
+"""
+
+from __future__ import annotations
+
+import typing
+
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.functions.runner import CompiledMethodRunner
+from flink_tensorflow_tpu.models.base import Model
+from flink_tensorflow_tpu.models.loaders import GraphLoader, SavedModelLoader
+from flink_tensorflow_tpu.tensors.batching import BucketLadder, BucketPolicy
+from flink_tensorflow_tpu.tensors.coercion import coerce
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+ModelSource = typing.Union[Model, str, SavedModelLoader, typing.Callable[[], Model]]
+
+
+def _resolve(source: ModelSource) -> Model:
+    if isinstance(source, Model):
+        return source
+    if isinstance(source, str):
+        return SavedModelLoader(source).load()
+    if isinstance(source, SavedModelLoader):
+        return source.load()
+    if callable(source):
+        return source()
+    raise TypeError(f"cannot resolve model source {type(source).__name__}")
+
+
+class _ModelFunctionBase(fn.RichFunction):
+    def __init__(
+        self,
+        model: ModelSource,
+        method: str = "serve",
+        *,
+        policy: typing.Optional[BucketPolicy] = None,
+        warmup_batches: typing.Sequence[int] = (),
+        warmup_length_bucket: int = 128,
+        donate_inputs: bool = True,
+    ):
+        self._source = model
+        self._method_name = method
+        self._policy = policy
+        self._warmup = tuple(warmup_batches)
+        self._warmup_length_bucket = warmup_length_bucket
+        self._donate = donate_inputs
+        self.runner: typing.Optional[CompiledMethodRunner] = None
+
+    def clone(self) -> "fn.Function":
+        # Subtasks share the host-side source (read-only); each builds its
+        # own runner/device placement at open().  Deepcopying params per
+        # subtask would multiply host RAM by parallelism for nothing.
+        import copy
+
+        dup = copy.copy(self)
+        dup.runner = None
+        return dup
+
+    def open(self, ctx) -> None:
+        model = _resolve(self._source)
+        self.runner = CompiledMethodRunner(
+            model,
+            self._method_name,
+            policy=self._policy,
+            donate_inputs=self._donate,
+        )
+        self.runner.open(ctx)
+        if self._warmup:
+            self.runner.warmup(self._warmup, self._warmup_length_bucket)
+
+    def close(self) -> None:
+        if self.runner is not None:
+            self.runner.close()
+            self.runner = None
+
+
+class ModelMapFunction(_ModelFunctionBase, fn.MapFunction):
+    """Per-record inference: ``stream.map(ModelMapFunction(bundle))``."""
+
+    def __init__(self, model: ModelSource, method: str = "serve", **kw):
+        kw.setdefault("policy", BucketPolicy(fixed_batch=1))
+        super().__init__(model, method, **kw)
+
+    def map(self, value):
+        return self.runner.run_batch([value])[0]
+
+
+class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
+    """Micro-batch inference: one jitted call per fired window.
+
+    Windows larger than the policy's biggest bucket are chunked into
+    multiple calls rather than failing batch assembly.
+    """
+
+    def process_window(self, key, window, elements, out: fn.Collector):
+        elements = list(elements)
+        policy = self.runner.policy
+        cap = policy.fixed_batch or policy.batch.sizes[-1]
+        for i in range(0, len(elements), cap):
+            for record in self.runner.run_batch(elements[i:i + cap]):
+                out.collect(record)
+
+
+class _GraphFunctionBase(fn.RichFunction):
+    """Runs a frozen function (jax.export artifact) instead of a Model.
+
+    Frozen artifacts are shape-specialized at export time, so the batch
+    policy is forced to the artifact's batch size.
+    """
+
+    def __init__(self, graph: typing.Union[str, bytes], *, batch: int,
+                 input_schema, needs_lengths: bool = False,
+                 length_bucket: int = 128):
+        self._graph_source = graph
+        self._batch = batch
+        self._schema = input_schema
+        self._needs_lengths = needs_lengths
+        self._call = None
+        # Frozen artifacts are shape-specialized at export time on BOTH
+        # the batch and the length bucket — pin both so assembly always
+        # produces exactly the shapes the serialized StableHLO requires
+        # (must match freeze_method's batch/length_bucket arguments).
+        self._policy = BucketPolicy(
+            fixed_batch=batch, lengths=BucketLadder([length_bucket])
+        )
+
+    def clone(self):
+        import copy
+
+        dup = copy.copy(self)
+        dup._call = None
+        return dup
+
+    def open(self, ctx) -> None:
+        self._call = GraphLoader(self._graph_source).load()
+
+    def close(self) -> None:
+        self._call = None
+
+    def _run(self, records) -> typing.List[TensorValue]:
+        from flink_tensorflow_tpu.tensors.batching import assemble
+        from flink_tensorflow_tpu.tensors.transfer import DeviceTransfer
+
+        tvs = [r if isinstance(r, TensorValue) else coerce(r, self._schema) for r in records]
+        batch = assemble(tvs, self._schema, self._policy)
+        if self._needs_lengths:
+            outputs = self._call(batch.arrays, batch.lengths)
+        else:
+            outputs = self._call(batch.arrays)
+        return batch.unbatch(DeviceTransfer.fetch(outputs))
+
+
+class GraphMapFunction(_GraphFunctionBase, fn.MapFunction):
+    def __init__(self, graph, *, input_schema, needs_lengths: bool = False):
+        super().__init__(graph, batch=1, input_schema=input_schema,
+                         needs_lengths=needs_lengths)
+
+    def map(self, value):
+        return self._run([value])[0]
+
+
+class GraphWindowFunction(_GraphFunctionBase, fn.WindowFunction):
+    def process_window(self, key, window, elements, out: fn.Collector):
+        # Frozen batch is fixed: chunk oversized windows.
+        elements = list(elements)
+        for i in range(0, len(elements), self._batch):
+            for record in self._run(elements[i:i + self._batch]):
+                out.collect(record)

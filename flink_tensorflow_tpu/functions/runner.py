@@ -1,0 +1,125 @@
+"""CompiledMethodRunner — the Session.run replacement.
+
+The reference's ``ModelFunction`` binds a model method to per-record (or
+per-window) ``Session.run`` calls across the JNI boundary (SURVEY.md §3.1
+hot loop).  The TPU-native engine room:
+
+- ``open()``: place params in HBM once (reference: Session owns variables
+  on device).  Optionally pre-warm executables for expected buckets so the
+  stream never stalls on a first-fire XLA compile.
+- per batch: coerce -> assemble (pad to bucket) -> ONE host->HBM transfer
+  -> ONE jitted call -> fetch -> unbatch.  ``jax.jit`` caches one
+  executable per bucket shape (the compile cache of SURVEY.md §7 step 3);
+  input buffers are donated so XLA reuses their HBM pages for outputs.
+- dispatch is async: the jitted call returns futures, and ``run_batch``
+  only blocks when fetching results — back-to-back windows overlap host
+  batching with device compute.
+"""
+
+from __future__ import annotations
+
+import time
+import typing
+
+from flink_tensorflow_tpu.models.base import Model
+from flink_tensorflow_tpu.tensors.batching import Batch, BucketPolicy, assemble
+from flink_tensorflow_tpu.tensors.coercion import coerce
+from flink_tensorflow_tpu.tensors.transfer import DeviceTransfer
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
+
+
+class CompiledMethodRunner:
+    """Executes one model method on one device, bucketed and compiled."""
+
+    def __init__(
+        self,
+        model: Model,
+        method_name: str = "serve",
+        *,
+        policy: typing.Optional[BucketPolicy] = None,
+        device=None,
+        donate_inputs: bool = False,
+    ):
+        self.model = model
+        self.method = model.method(method_name)
+        self.policy = policy or BucketPolicy()
+        self.device = device
+        self.donate_inputs = donate_inputs
+        self._params_on_device = None
+        self._jit_fn = None
+        self._transfer: typing.Optional[DeviceTransfer] = None
+        self._metrics = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self, ctx: typing.Optional["RuntimeContext"] = None) -> None:
+        import jax
+
+        device = self.device
+        if device is None and ctx is not None and ctx.device is not None:
+            device = ctx.device
+        self.device = device
+        self._transfer = DeviceTransfer(device)
+        # Params to HBM once — the Session-owns-variables analogue.
+        self._params_on_device = jax.device_put(self.model.params, device)
+
+        method = self.method
+        if method.needs_lengths:
+            def call(params, inputs, lengths):
+                return method.fn(params, inputs, lengths)
+        else:
+            def call(params, inputs):
+                return method.fn(params, inputs)
+        # Inference outputs (logits/labels) never alias input image/token
+        # buffers, so donation buys nothing here and XLA warns per bucket;
+        # opt in only for methods whose outputs can reuse input pages.
+        donate = (1,) if self.donate_inputs else ()
+        # Pin execution to the subtask's device; params already live there.
+        self._jit_fn = jax.jit(call, donate_argnums=donate)
+        if ctx is not None:
+            self._metrics = ctx.metrics
+
+    def warmup(self, batch_sizes: typing.Iterable[int], length_bucket: int = 128) -> None:
+        """Pre-compile executables for the given batch buckets (open-time,
+        so the first live window doesn't pay the 20-40s XLA compile)."""
+        import numpy as np
+
+        schema = self.method.input_schema
+        shapes = schema.resolve_dynamic(length_bucket)
+        for b in batch_sizes:
+            fields = {n: np.zeros(shapes[n], schema[n].dtype) for n in schema.names}
+            self.run_batch([TensorValue(fields)] * b)
+
+    def close(self) -> None:
+        self._params_on_device = None
+        self._jit_fn = None
+
+    # -- execution ---------------------------------------------------------
+    def run_batch(self, records: typing.Sequence[typing.Any]) -> typing.List[TensorValue]:
+        """Run one micro-batch; returns one output record per input record."""
+        if self._jit_fn is None:
+            raise RuntimeError("runner not opened")
+        t0 = time.monotonic()
+        tvs = [
+            r if isinstance(r, TensorValue) else coerce(r, self.method.input_schema)
+            for r in records
+        ]
+        batch = assemble(tvs, self.method.input_schema, self.policy)
+        inputs = self._transfer.to_device(batch)
+        if self.method.needs_lengths:
+            lengths = self._transfer.lengths_to_device(batch)
+            outputs = self._jit_fn(self._params_on_device, inputs, lengths)
+        else:
+            outputs = self._jit_fn(self._params_on_device, inputs)
+        host = DeviceTransfer.fetch(outputs)
+        results = batch.unbatch(host)
+        if self._metrics is not None:
+            dt = time.monotonic() - t0
+            self._metrics.meter("records").mark(len(results))
+            self._metrics.histogram("batch_latency_s").record(dt)
+            self._metrics.histogram("record_latency_s").record(dt / max(1, len(results)))
+            self._metrics.counter("batches").inc()
+            self._metrics.counter("padded_records").inc(batch.padded_size - batch.num_records)
+        return results

@@ -132,11 +132,11 @@ def freeze_method(model: Model, method_name: str = "serve", *, batch: int = 1,
 def _example_inputs(schema, batch: int, length_bucket: int):
     import jax
 
-    out = {}
-    for name, spec in schema:
-        shape = tuple(length_bucket if d is None else d for d in spec.shape)
-        out[name] = jax.ShapeDtypeStruct((batch, *shape), spec.dtype)
-    return out
+    shapes = schema.resolve_dynamic(length_bucket)
+    return {
+        name: jax.ShapeDtypeStruct((batch, *shapes[name]), schema[name].dtype)
+        for name in schema.names
+    }
 
 
 class GraphLoader:

@@ -197,10 +197,8 @@ def build(num_classes: int = 1000, image_size: int = 299) -> ModelDef:
     def loss_fn(variables, batch, rng):
         import optax
 
-        params = {k: v for k, v in variables.items() if k != "batch_stats"}
         logits, new_state = module.apply(
-            {**params, "batch_stats": variables["batch_stats"]},
-            batch["image"], train=True, mutable=["batch_stats"],
+            variables, batch["image"], train=True, mutable=["batch_stats"],
             rngs={"dropout": rng},
         )
         labels = batch["label"]

@@ -93,10 +93,8 @@ def build(num_classes: int = 1000, image_size: int = 224, width: int = 64,
     def loss_fn(variables, batch, rng):
         import optax
 
-        params = {k: v for k, v in variables.items() if k != "batch_stats"}
         logits, new_state = module.apply(
-            {**params, "batch_stats": variables["batch_stats"]},
-            batch["image"], train=True, mutable=["batch_stats"],
+            variables, batch["image"], train=True, mutable=["batch_stats"],
         )
         labels = batch["label"]
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

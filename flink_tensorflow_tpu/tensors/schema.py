@@ -104,6 +104,15 @@ class RecordSchema:
         for name, spec in self.fields.items():
             spec.validate(np.asarray(record[name]))
 
+    def resolve_dynamic(self, length_bucket: int) -> typing.Dict[str, typing.Tuple[int, ...]]:
+        """Per-record shapes with every dynamic dim pinned to
+        ``length_bucket`` — THE rule for turning a dynamic schema into the
+        static shapes XLA sees (shared by frozen exports and warmup)."""
+        return {
+            name: tuple(length_bucket if d is None else d for d in spec.shape)
+            for name, spec in self.fields.items()
+        }
+
     def batched_struct(self, batch: int):
         """``jax.ShapeDtypeStruct`` pytree for a ``[B, ...]`` batch — feeds
         ``jax.eval_shape``/AOT compilation without materializing data."""

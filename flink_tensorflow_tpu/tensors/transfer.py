@@ -49,8 +49,22 @@ class DeviceTransfer:
 
     @staticmethod
     def fetch(outputs) -> typing.Dict[str, np.ndarray]:
-        """Device -> host for a pytree of outputs (blocks on the transfer)."""
+        """Device -> host for a pytree of outputs (blocks on the transfer).
+
+        Fetched arrays are frozen so per-record row views taken by
+        ``Batch.unbatch`` are born read-only — TensorValue then aliases
+        them instead of copying (keeps the output path at 1x traffic).
+        """
         import jax
 
         host = jax.device_get(outputs)
-        return {n: np.asarray(a) for n, a in host.items()}
+        out = {}
+        for n, a in host.items():
+            a = np.asarray(a)
+            if a.flags.writeable and a.flags.owndata:
+                a.setflags(write=False)
+            elif a.flags.writeable:
+                a = a.copy()
+                a.setflags(write=False)
+            out[n] = a
+        return out

@@ -1,0 +1,141 @@
+"""ModelFunction-in-stream integration tests — the reference's MiniCluster
+end-to-end shape (SURVEY.md §4): a bounded stream through a model operator
+with a tiny model, asserting exact outputs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.functions import (
+    GraphWindowFunction,
+    ModelMapFunction,
+    ModelWindowFunction,
+)
+from flink_tensorflow_tpu.models import freeze_method, get_model_def, save_bundle
+from flink_tensorflow_tpu.tensors import BucketPolicy, TensorValue
+
+
+@pytest.fixture(scope="module")
+def lenet_model():
+    mdef = get_model_def("lenet")
+    params = jax.jit(mdef.init_fn)(jax.random.key(0))
+    return mdef.to_model(params)
+
+
+@pytest.fixture(scope="module")
+def images():
+    rng = np.random.RandomState(7)
+    return [
+        TensorValue({"image": rng.rand(28, 28, 1).astype(np.float32)}, {"i": i})
+        for i in range(10)
+    ]
+
+
+@pytest.fixture(scope="module")
+def expected_labels(lenet_model, images):
+    serve = jax.jit(lenet_model.method("serve").fn)
+    batch = jnp.stack([jnp.asarray(r["image"]) for r in images])
+    out = serve(lenet_model.params, {"image": batch})
+    return [int(x) for x in np.asarray(out["label"])]
+
+
+class TestModelWindowFunction:
+    def test_windowed_microbatch_inference(self, lenet_model, images, expected_labels):
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images)
+            .count_window(4)
+            .apply(ModelWindowFunction(lenet_model))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert len(results) == 10
+        got = {r.meta["i"]: int(r["label"]) for r in results}
+        assert got == {i: l for i, l in enumerate(expected_labels)}
+
+    def test_parallel_subtasks_share_host_model(self, lenet_model, images, expected_labels):
+        env = StreamExecutionEnvironment(parallelism=2)
+        results = (
+            env.from_collection(images)
+            .rebalance()
+            .count_window(4, timeout_s=0.2)
+            .apply(ModelWindowFunction(lenet_model), parallelism=2)
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        got = {r.meta["i"]: int(r["label"]) for r in results}
+        assert got == {i: l for i, l in enumerate(expected_labels)}
+
+    def test_oversized_window_chunks(self, lenet_model, images, expected_labels):
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images)
+            .count_window(10)
+            .apply(ModelWindowFunction(lenet_model, policy=BucketPolicy(fixed_batch=4)))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        got = {r.meta["i"]: int(r["label"]) for r in results}
+        assert got == {i: l for i, l in enumerate(expected_labels)}
+
+    def test_bundle_path_source(self, lenet_model, images, expected_labels, tmp_path):
+        mdef = get_model_def("lenet")
+        path = str(tmp_path / "bundle")
+        save_bundle(mdef, lenet_model.params, path)
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images[:4])
+            .count_window(4)
+            .apply(ModelWindowFunction(path))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert [int(r["label"]) for r in results] == expected_labels[:4]
+
+
+class TestModelMapFunction:
+    def test_per_record_inference(self, lenet_model, images, expected_labels):
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images[:3])
+            .map(ModelMapFunction(lenet_model))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert [int(r["label"]) for r in results] == expected_labels[:3]
+
+
+class TestGraphFunction:
+    def test_frozen_window_inference(self, lenet_model, images, expected_labels):
+        frozen = freeze_method(lenet_model, "serve", batch=4)
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images)
+            .count_window(4)
+            .apply(GraphWindowFunction(
+                frozen, batch=4,
+                input_schema=lenet_model.method("serve").input_schema,
+            ))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        got = {r.meta["i"]: int(r["label"]) for r in results}
+        assert got == {i: l for i, l in enumerate(expected_labels)}
+
+
+class TestMetrics:
+    def test_inference_metrics_populated(self, lenet_model, images):
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_collection(images)
+            .count_window(5)
+            .apply(ModelWindowFunction(lenet_model), name="infer")
+            .sink_to_list()
+        )
+        result = env.execute(timeout=120)
+        assert result.metrics["infer.0.records"]["count"] == 10
+        assert result.metrics["infer.0.batches"] == 2
+        assert result.metrics["infer.0.record_latency_s"]["p50"] > 0
