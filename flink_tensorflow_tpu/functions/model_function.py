@@ -59,7 +59,7 @@ class _ModelFunctionBase(fn.RichFunction):
         policy: typing.Optional[BucketPolicy] = None,
         warmup_batches: typing.Sequence[int] = (),
         warmup_length_bucket: int = 128,
-        donate_inputs: bool = True,
+        donate_inputs: bool = False,
     ):
         self._source = model
         self._method_name = method
@@ -174,9 +174,10 @@ class _GraphFunctionBase(fn.RichFunction):
 
 
 class GraphMapFunction(_GraphFunctionBase, fn.MapFunction):
-    def __init__(self, graph, *, input_schema, needs_lengths: bool = False):
+    def __init__(self, graph, *, input_schema, needs_lengths: bool = False,
+                 length_bucket: int = 128):
         super().__init__(graph, batch=1, input_schema=input_schema,
-                         needs_lengths=needs_lengths)
+                         needs_lengths=needs_lengths, length_bucket=length_bucket)
 
     def map(self, value):
         return self._run([value])[0]

@@ -1,0 +1,53 @@
+"""Parallelism layer — meshes, shardings, DP training, ring attention,
+multi-host formation.
+
+TPU-native replacement for the reference's ClusterSpec+NCCL distributed
+path (BASELINE.json:5; SURVEY.md §2 "Distributed communication backend"):
+collectives are emitted by XLA from sharding annotations and ride ICI/DCN.
+"""
+
+from flink_tensorflow_tpu.parallel.dp import (
+    init_train_state,
+    make_dp_train_step,
+    make_train_step,
+)
+from flink_tensorflow_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    MeshSpec,
+    batch_sharding,
+    make_mesh,
+    named_sharding,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from flink_tensorflow_tpu.parallel.ring_attention import (
+    full_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "EXPERT_AXIS",
+    "MODEL_AXIS",
+    "MeshSpec",
+    "PIPE_AXIS",
+    "SEQ_AXIS",
+    "batch_sharding",
+    "full_attention",
+    "init_train_state",
+    "make_dp_train_step",
+    "make_mesh",
+    "make_train_step",
+    "named_sharding",
+    "replicate",
+    "replicated",
+    "ring_attention",
+    "ring_attention_sharded",
+    "shard_batch",
+]

@@ -1,0 +1,97 @@
+"""Data-parallel training — ClusterSpec+NCCL allreduce, the XLA way.
+
+Reference mechanism (SURVEY.md §3.5): N subtasks each run forward/backward
+in their session; gradients cross processes via TF distributed runtime +
+NCCL ring; optimizer state is replicated.  TPU-native (BASELINE.json:5):
+ONE jitted train step whose input shardings say "batch split over ``data``,
+state replicated" — XLA sees replicated params consumed by sharded batches
+and inserts the gradient AllReduce over ICI itself.  The framework never
+spells a collective.
+
+``TrainState`` is an explicit pytree (variables + optimizer state + step +
+rng).  That it is *explicit* is the point: the reference hides variables
+inside the TF session where Flink checkpoints cannot see them (SURVEY.md
+§5 "Checkpoint / resume" caveat); here the state rides the operator
+snapshot protocol like any other state.
+"""
+
+from __future__ import annotations
+
+import typing
+
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef
+from flink_tensorflow_tpu.parallel.mesh import batch_sharding, replicated
+
+TrainState = typing.Dict[str, typing.Any]  # variables / opt_state / step / rng
+
+
+def init_train_state(model_def: ModelDef, optimizer, rng) -> TrainState:
+    """Fresh training state (host-side; place on mesh via ``replicate``)."""
+    import jax
+    import jax.numpy as jnp
+
+    variables = jax.jit(model_def.init_fn)(rng)
+    params = variables["params"]
+    return {
+        "variables": variables,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+        "rng": jax.random.fold_in(rng, 1),
+    }
+
+
+def make_train_step(model_def: ModelDef, optimizer):
+    """Pure ``(state, batch) -> (state, metrics)`` single-step function.
+
+    Differentiates ``model_def.loss_fn`` w.r.t. the ``params`` collection
+    only; other collections (batch_stats) flow through as the loss_fn's
+    auxiliary model-state output.
+    """
+    import jax
+    import optax
+
+    loss_fn = model_def.loss_fn
+    if loss_fn is None:
+        raise ValueError(f"model {model_def.architecture} has no loss_fn")
+
+    def step(state: TrainState, batch) -> typing.Tuple[TrainState, dict]:
+        rng = jax.random.fold_in(state["rng"], state["step"])
+        variables = state["variables"]
+
+        def compute(params):
+            return loss_fn({**variables, "params": params}, batch, rng)
+
+        grads, (new_model_state, metrics) = jax.grad(compute, has_aux=True)(
+            variables["params"]
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], variables["params"]
+        )
+        params = optax.apply_updates(variables["params"], updates)
+        new_state = {
+            "variables": {**variables, "params": params, **new_model_state},
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+            "rng": state["rng"],
+        }
+        return new_state, metrics
+
+    return step
+
+
+def make_dp_train_step(model_def: ModelDef, optimizer, mesh):
+    """Jit the train step over a mesh: batch sharded on ``data``, state
+    replicated, state buffers donated (params update in place in HBM).
+
+    The emitted executable contains the gradient AllReduce over ICI — the
+    entire NCCL/ClusterSpec apparatus of the reference, compiled away.
+    """
+    import jax
+
+    step = make_train_step(model_def, optimizer)
+    return jax.jit(
+        step,
+        in_shardings=(replicated(mesh), batch_sharding(mesh)),
+        out_shardings=(replicated(mesh), replicated(mesh)),
+        donate_argnums=(0,),
+    )

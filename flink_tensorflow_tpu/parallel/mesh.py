@@ -1,0 +1,132 @@
+"""Device meshes — the ClusterSpec replacement.
+
+The reference's distributed story is TF ``ClusterSpec`` + NCCL allreduce
+(BASELINE.json:5): explicit worker addresses, explicit ring collectives.
+TPU-native, the whole thing collapses into a named :class:`jax.sharding.Mesh`
+(SURVEY.md §2 "Distributed communication backend"): axes are declared, data
+is annotated with `NamedSharding`, and XLA emits the collectives over ICI
+(intra-slice) / DCN (across slices).  No communication code in user jobs.
+
+Axis conventions (fixed names so operators, train steps, and kernels agree):
+
+- ``data``  — data parallelism: batch sharded, params replicated (or FSDP).
+- ``model`` — tensor parallelism: weight matrices sharded.
+- ``seq``   — sequence/context parallelism: ring attention shards tokens.
+- ``pipe``  — pipeline parallelism: layer stages.
+- ``expert``— expert parallelism for MoE layers.
+
+The reference only exercises ``data`` (SURVEY.md §2 parallelism table); the
+other axes exist so the mesh API doesn't preclude them (SURVEY.md §5) and
+are exercised by the long-context path (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import typing
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+#: Canonical axis order: DCN-adjacent parallelism first (pipe/data tolerate
+#: lower bandwidth), ICI-hungry axes (model/seq) innermost where the device
+#: mesh puts physically-adjacent chips (scaling-book mesh recipe).
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh: axis name -> size.  Size 1 axes are kept (they make
+    shardings explicit and cost nothing)."""
+
+    axes: typing.Mapping[str, int]
+
+    def __post_init__(self):
+        unknown = set(self.axes) - set(AXIS_ORDER)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {unknown}; known: {AXIS_ORDER}")
+        for name, size in self.axes.items():
+            if size < 1:
+                raise ValueError(f"axis {name} must be >=1, got {size}")
+        object.__setattr__(self, "axes", dict(self.axes))
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.axes.values())
+
+    @property
+    def axis_names(self) -> typing.Tuple[str, ...]:
+        return tuple(a for a in AXIS_ORDER if a in self.axes)
+
+    def build(self, devices: typing.Optional[typing.Sequence] = None):
+        """Materialize a ``jax.sharding.Mesh`` over real (or given) devices.
+
+        Device order comes from ``mesh_utils.create_device_mesh``, which
+        lays physically-adjacent TPU chips along the innermost axes so
+        ``model``/``seq`` collectives ride the shortest ICI hops.
+        """
+        import jax
+        from jax.experimental import mesh_utils
+
+        names = self.axis_names
+        shape = tuple(self.axes[a] for a in names)
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) != self.num_devices:
+            raise ValueError(
+                f"mesh {dict(self.axes)} needs {self.num_devices} devices, "
+                f"have {len(devices)}"
+            )
+        if devices and getattr(devices[0], "platform", None) == "tpu":
+            # Physical-topology-aware layout; a failure here is a real
+            # configuration error and must stay loud (a silent row-major
+            # fallback would quietly cost ICI adjacency).
+            dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        else:
+            # CPU/virtual platforms have no topology: row-major reshape.
+            import numpy as np
+
+            dev_array = np.asarray(list(devices)).reshape(shape)
+        return jax.sharding.Mesh(dev_array, names)
+
+
+def make_mesh(axes: typing.Mapping[str, int], devices=None):
+    """``make_mesh({"data": 8})`` -> Mesh; the one-liner for jobs."""
+    return MeshSpec(axes).build(devices)
+
+
+# -- shardings --------------------------------------------------------------
+
+def named_sharding(mesh, *spec):
+    """``named_sharding(mesh, "data", None)`` -> NamedSharding(P("data", None))."""
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+
+
+def batch_sharding(mesh):
+    """Shard dim 0 of every leaf across ``data`` (x ``seq`` if present for
+    token streams handled elsewhere) — the canonical input-batch placement."""
+    return named_sharding(mesh, DATA_AXIS)
+
+
+def replicated(mesh):
+    return named_sharding(mesh)
+
+
+def shard_batch(mesh, pytree):
+    """Place a host batch pytree on the mesh, dim 0 split over ``data``."""
+    import jax
+
+    return jax.device_put(pytree, batch_sharding(mesh))
+
+
+def replicate(mesh, pytree):
+    """Replicate params/state across the whole mesh (pure-DP placement)."""
+    import jax
+
+    return jax.device_put(pytree, replicated(mesh))

@@ -1,0 +1,139 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context support is first-class in this framework even though the
+reference has none (SURVEY.md §5 "Long-context": its longest-sequence path
+is BiLSTM bucketing).  Design (Liu et al. 2023, blockwise ring attention;
+see PAPERS.md — pattern reference only):
+
+Tokens are sharded ``[B, T/n, H, D]`` across n ``seq`` devices.  Each
+device computes flash-style online-softmax attention of its local Q block
+against K/V blocks that rotate around the ring via ``lax.ppermute`` — after
+n-1 hops every Q has attended to every K/V without any device ever holding
+the full sequence or the full ``T x T`` score matrix.  Communication is
+neighbor-to-neighbor only, so it rides the ICI torus at full bandwidth and
+overlaps with the per-block attention compute.
+
+Accumulation is float32 (max ``m``, denominator ``l``, numerator ``o``)
+regardless of input dtype; inputs may be bfloat16.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import typing
+
+from flink_tensorflow_tpu.parallel.mesh import SEQ_AXIS
+
+
+def _block_attention(q, k, v, m, l, o, mask):
+    """One flash step: fold K/V block into the online-softmax accumulators.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; m,l: [B, H, Tq]; o: [B, Tq, H, D];
+    mask: [Tq, Tk] bool (True = attend) or None.
+    """
+    import jax.numpy as jnp
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # exp(-inf - -inf) guard: fully-masked rows keep p = 0.
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    alpha = jnp.exp(m - m_new)
+    alpha = jnp.where(jnp.isnan(alpha), 0.0, alpha)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False):
+    """Ring attention body — call INSIDE ``shard_map`` over ``axis_name``.
+
+    q/k/v: the local shard ``[B, T_local, H, D]``.  Returns the local
+    attention output shard ``[B, T_local, H, D]`` in q's dtype.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    # Derive accumulators from q so they inherit q's varying mesh axes
+    # (shard_map vma rules: fori_loop carry types must match exactly).
+    zeros_bht = jnp.sum(qf, axis=-1).transpose(0, 2, 1) * 0.0  # [B,H,T]
+    m0 = zeros_bht - jnp.inf
+    l0 = zeros_bht
+    o0 = qf * 0.0
+    # Ring: receive from the previous rank, send to the next — K/V block i
+    # on this device originated at rank (my - i) mod n.
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        src = (my - i) % n
+        if causal:
+            q_pos = my * t + jnp.arange(t)[:, None]
+            k_pos = src * t + jnp.arange(t)[None, :]
+            mask = k_pos <= q_pos
+        else:
+            mask = None
+        m, l, o = _block_attention(qf, k_blk, v_blk, m, l, o, mask)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, o
+
+    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    # Fully-masked rows (can happen only with exotic masks) -> 0, not NaN.
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = o / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(mesh, q, k, v, *, causal: bool = False):
+    """User-facing ring attention over a mesh with a ``seq`` axis.
+
+    q/k/v: global ``[B, T, H, D]`` arrays (host or device); T must divide
+    by the seq-axis size.  Output: global ``[B, T, H, D]``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flink_tensorflow_tpu.parallel.mesh import DATA_AXIS
+
+    # Batch rides the data axis when the mesh has one (dp x sp composes).
+    batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+    spec = P(batch_axis, SEQ_AXIS, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_sharded, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return jax.jit(fn)(q, k, v)
+
+
+def full_attention(q, k, v, *, causal: bool = False):
+    """Unsharded reference implementation (tests/golden baseline)."""
+    import jax.numpy as jnp
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_k)[None, :] <= jnp.arange(t_q)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = out / jnp.sum(p, axis=-1).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

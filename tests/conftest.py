@@ -8,10 +8,19 @@ at conftest import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment preselects a TPU platform (e.g.
+# JAX_PLATFORMS=axon tunneling to one real chip): tests need the virtual
+# 8-device mesh, and must not monopolize/depend on bench hardware.  The
+# env var alone is not enough — the axon PJRT plugin re-registers itself
+# as default — so pin the platform via jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
