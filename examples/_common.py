@@ -1,0 +1,65 @@
+"""Shared plumbing for the example jobs (platform selection, data gen,
+reporting).  Each example mirrors one reference workload (BASELINE.json:6-12)
+as a runnable job script — the reference ships its workloads as Flink job
+mains (SURVEY.md §1 L6)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--records", type=int, default=256, help="stream length")
+    p.add_argument("--batch", type=int, default=32, help="micro-batch / window size")
+    p.add_argument("--parallelism", type=int, default=1)
+    p.add_argument("--cpu", action="store_true",
+                   help="force CPU with 8 virtual devices (default: real TPU if present)")
+    p.add_argument("--smoke", action="store_true", help="tiny sizes for CI")
+    return p
+
+
+def select_platform(force_cpu: bool, virtual_devices: int = 8) -> None:
+    """Must run before jax touches a backend."""
+    if force_cpu:
+        from flink_tensorflow_tpu.utils.platform import force_cpu as _force
+
+        _force(virtual_devices)
+
+
+def synthetic_images(n: int, size: int, channels: int = 3, seed: int = 0):
+    """Deterministic fake image records (the examples are about the
+    streaming+model path, not datasets — reference examples fetch
+    Inception inputs at run time too, SURVEY.md §4 fixtures note)."""
+    from flink_tensorflow_tpu.tensors import TensorValue
+
+    rng = np.random.RandomState(seed)
+    return [
+        TensorValue(
+            {"image": rng.rand(size, size, channels).astype(np.float32)},
+            {"id": i},
+        )
+        for i in range(n)
+    ]
+
+
+def report(job: str, metrics: dict, t0: float, records: int, extra: dict = None):
+    """One human-readable summary + one machine-readable JSON line."""
+    wall = time.time() - t0
+    out = {
+        "job": job,
+        "records": records,
+        "wall_s": round(wall, 3),
+        "records_per_s": round(records / wall, 2) if wall > 0 else None,
+    }
+    out.update(extra or {})
+    for key, value in metrics.items():
+        if key.endswith("record_latency_s") and isinstance(value, dict):
+            out["p50_latency_ms"] = round(value["p50"] * 1e3, 3)
+            out["p99_latency_ms"] = round(value["p99"] * 1e3, 3)
+    print(json.dumps(out))
+    return out

@@ -1,0 +1,67 @@
+"""Inception-v3 streaming image labeling — the flagship workload.
+
+Reference: the Inception demo job, a bounded DataStream of images mapped
+through a ``ModelFunction`` running a frozen Inception-v3 graph in an
+embedded TF session (BASELINE.json:7; SURVEY.md §3.1).  This job is the
+north-star measurement path (BASELINE.json:2): records/sec/chip and p50
+per-record latency.
+
+TPU-native shape: images arrive as records, a count-or-timeout window
+micro-batches them, and each fired window is ONE jitted bfloat16 forward
+on a ``[B, 299, 299, 3]`` HBM-resident batch.
+
+Run:  python examples/inception_inference.py --records 512 --batch 32
+      python examples/inception_inference.py --smoke --cpu   # CI-safe
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")  # repo-root invocation
+from examples._common import base_parser, report, select_platform, synthetic_images
+
+
+def main(argv=None):
+    args = base_parser(__doc__).parse_args(argv)
+    select_platform(args.cpu)
+    if args.smoke:
+        args.records, args.batch = 16, 8
+
+    import jax
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment
+    from flink_tensorflow_tpu.functions import ModelWindowFunction
+    from flink_tensorflow_tpu.models import get_model_def
+    from flink_tensorflow_tpu.tensors import BucketPolicy
+
+    num_classes = 10 if args.smoke else 1000
+    mdef = get_model_def("inception_v3", num_classes=num_classes)
+    model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+    records = synthetic_images(args.records, 299)
+
+    env = StreamExecutionEnvironment(parallelism=args.parallelism)
+    results = (
+        env.from_collection(records, parallelism=1)
+        .rebalance()
+        .count_window(args.batch, timeout_s=0.05)
+        .apply(
+            ModelWindowFunction(
+                model,
+                policy=BucketPolicy(fixed_batch=args.batch),
+                warmup_batches=(args.batch,),
+            ),
+            name="inception",
+            parallelism=args.parallelism,
+        )
+        .sink_to_list()
+    )
+    t0 = time.time()
+    job = env.execute("inception-v3-labeling", timeout=3600)
+    assert len(results) == args.records, (len(results), args.records)
+    labels = [int(r["label"]) for r in results[:5]]
+    return report("inception_v3_streaming_inference", job.metrics, t0,
+                  args.records, {"sample_labels": labels})
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,9 @@ class ProcessFunction(RichFunction, abc.ABC):
     def on_timer(self, timestamp: float, ctx: "ProcessContext", out: Collector) -> None:  # noqa: B027
         """Called when a registered processing-time timer fires."""
 
+    def on_finish(self, out: Collector) -> None:  # noqa: B027
+        """End of input: flush buffered work (e.g. partial mini-batches)."""
+
 
 class ProcessContext:
     """Per-element context: timestamp, current key, timers, keyed state."""
@@ -120,6 +123,10 @@ class WindowFunction(RichFunction, abc.ABC):
         elements: typing.Sequence[typing.Any],
         out: Collector,
     ) -> None: ...
+
+    def on_finish(self, out: Collector) -> None:  # noqa: B027
+        """End of input, after all remaining windows fired: flush any
+        asynchronously in-flight work (e.g. pipelined model batches)."""
 
 
 class SourceFunction(RichFunction, abc.ABC):

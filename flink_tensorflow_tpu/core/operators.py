@@ -186,6 +186,9 @@ class ProcessOperator(_FunctionOperator):
         self._pctx.timestamp = record.timestamp
         self.function.process_element(record.value, self._pctx, self._collector)
 
+    def finish(self):
+        self.function.on_finish(self._collector)
+
     def next_deadline(self):
         if not self._timers:
             return None
@@ -261,6 +264,11 @@ class WindowOperator(_FunctionOperator):
         deadlines = [
             d for d in (self.trigger.deadline(buf) for buf in self._buffers.values()) if d is not None
         ]
+        # Functions with async in-flight work (pipelined model batches)
+        # declare their own wake-up so results never strand in a lull.
+        fn_deadline = getattr(self.function, "next_deadline", None)
+        if fn_deadline is not None and (d := fn_deadline()) is not None:
+            deadlines.append(d)
         return min(deadlines) if deadlines else None
 
     def fire_due(self, now):
@@ -271,10 +279,14 @@ class WindowOperator(_FunctionOperator):
         ]
         for key in due:
             self._fire(key, self._buffers[key])
+        fn_fire = getattr(self.function, "fire_due", None)
+        if fn_fire is not None:
+            fn_fire(now)
 
     def finish(self):
         for key in list(self._buffers.keys()):
             self._fire(key, self._buffers[key])
+        self.function.on_finish(self._collector)
 
     def _operator_snapshot(self):
         return {

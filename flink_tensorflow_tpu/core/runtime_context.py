@@ -9,6 +9,7 @@ SURVEY.md §7 hard part 4).
 
 from __future__ import annotations
 
+import contextlib
 import typing
 
 from flink_tensorflow_tpu.core.state import KeyedStateStore, StateDescriptor
@@ -43,3 +44,14 @@ class RuntimeContext:
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)
+
+    @contextlib.contextmanager
+    def with_key(self, key):
+        """Scope keyed-state access to ``key`` outside the per-element
+        window (end-of-input flushes, timer callbacks across keys)."""
+        prev = self._keyed_state.current_key
+        self._keyed_state.current_key = key
+        try:
+            yield
+        finally:
+            self._keyed_state.current_key = prev

@@ -8,9 +8,15 @@ from flink_tensorflow_tpu.functions.model_function import (
     ModelWindowFunction,
 )
 from flink_tensorflow_tpu.functions.runner import CompiledMethodRunner
+from flink_tensorflow_tpu.functions.training_function import (
+    DPTrainWindowFunction,
+    OnlineTrainFunction,
+)
 
 __all__ = [
     "CompiledMethodRunner",
+    "DPTrainWindowFunction",
+    "OnlineTrainFunction",
     "GraphMapFunction",
     "GraphWindowFunction",
     "ModelMapFunction",

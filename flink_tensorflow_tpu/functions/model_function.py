@@ -60,6 +60,7 @@ class _ModelFunctionBase(fn.RichFunction):
         warmup_batches: typing.Sequence[int] = (),
         warmup_length_bucket: int = 128,
         donate_inputs: bool = False,
+        outputs: typing.Optional[typing.Sequence[str]] = None,
     ):
         self._source = model
         self._method_name = method
@@ -67,7 +68,9 @@ class _ModelFunctionBase(fn.RichFunction):
         self._warmup = tuple(warmup_batches)
         self._warmup_length_bucket = warmup_length_bucket
         self._donate = donate_inputs
+        self._outputs = outputs
         self.runner: typing.Optional[CompiledMethodRunner] = None
+        self._out: typing.Optional[fn.Collector] = None
 
     def clone(self) -> "fn.Function":
         # Subtasks share the host-side source (read-only); each builds its
@@ -77,6 +80,7 @@ class _ModelFunctionBase(fn.RichFunction):
 
         dup = copy.copy(self)
         dup.runner = None
+        dup._out = None
         return dup
 
     def open(self, ctx) -> None:
@@ -86,6 +90,7 @@ class _ModelFunctionBase(fn.RichFunction):
             self._method_name,
             policy=self._policy,
             donate_inputs=self._donate,
+            output_names=self._outputs,
         )
         self.runner.open(ctx)
         if self._warmup:
@@ -113,15 +118,63 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
 
     Windows larger than the policy's biggest bucket are chunked into
     multiple calls rather than failing batch assembly.
+
+    Dispatch is pipelined (``pipeline_depth`` batches in flight): while
+    the device runs window k, the host batches and ships window k+1 —
+    transfer hides under compute, which is the throughput lever on
+    PCIe/tunnel-attached chips.  In-flight batches are flushed at end of
+    input and before every state snapshot, so barriers never have results
+    in limbo (exactly-once, SURVEY.md §7 hard part 5).
     """
 
+    def __init__(self, model: ModelSource, method: str = "serve", *,
+                 pipeline_depth: int = 2, idle_flush_s: float = 0.05, **kw):
+        super().__init__(model, method, **kw)
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self._max_in_flight = pipeline_depth - 1
+        self._idle_flush_s = idle_flush_s
+        self._last_dispatch: typing.Optional[float] = None
+
     def process_window(self, key, window, elements, out: fn.Collector):
+        import time
+
         elements = list(elements)
         policy = self.runner.policy
         cap = policy.fixed_batch or policy.batch.sizes[-1]
         for i in range(0, len(elements), cap):
-            for record in self.runner.run_batch(elements[i:i + cap]):
+            self.runner.dispatch(elements[i:i + cap])
+            for record in self.runner.collect_ready(self._max_in_flight):
                 out.collect(record)
+        self._last_dispatch = time.monotonic()
+        self._out = out
+
+    # Timer hooks (WindowOperator.next_deadline/fire_due): if the stream
+    # goes quiet with batches in flight, flush them after idle_flush_s —
+    # pipelining must not defeat the timeout trigger's latency bound.
+    def next_deadline(self) -> typing.Optional[float]:
+        if self.runner is None or not self.runner._pending or self._last_dispatch is None:
+            return None
+        return self._last_dispatch + self._idle_flush_s
+
+    def fire_due(self, now: float) -> None:
+        d = self.next_deadline()
+        if d is not None and now >= d and self._out is not None:
+            for record in self.runner.flush():
+                self._out.collect(record)
+
+    def on_finish(self, out: fn.Collector):
+        for record in self.runner.flush():
+            out.collect(record)
+
+    def snapshot_state(self):
+        # Barrier alignment: emit everything in flight BEFORE the snapshot
+        # is taken — the emissions precede the forwarded barrier, keeping
+        # the snapshot consistent with the downstream stream position.
+        if self.runner is not None and getattr(self, "_out", None) is not None:
+            for record in self.runner.flush():
+                self._out.collect(record)
+        return None
 
 
 class _GraphFunctionBase(fn.RichFunction):

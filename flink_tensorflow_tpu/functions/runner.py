@@ -18,6 +18,7 @@ hot loop).  The TPU-native engine room:
 
 from __future__ import annotations
 
+import collections
 import time
 import typing
 
@@ -42,16 +43,24 @@ class CompiledMethodRunner:
         policy: typing.Optional[BucketPolicy] = None,
         device=None,
         donate_inputs: bool = False,
+        output_names: typing.Optional[typing.Sequence[str]] = None,
     ):
         self.model = model
         self.method = model.method(method_name)
         self.policy = policy or BucketPolicy()
         self.device = device
         self.donate_inputs = donate_inputs
+        #: Subset of method outputs to return; selection happens INSIDE the
+        #: jitted fn so XLA dead-code-eliminates unused heads and the
+        #: device->host fetch only moves what the job consumes (fetch bytes
+        #: are a first-order cost on tunneled/PCIe-attached devices).
+        self.output_names = tuple(output_names) if output_names is not None else None
         self._params_on_device = None
         self._jit_fn = None
         self._transfer: typing.Optional[DeviceTransfer] = None
         self._metrics = None
+        #: In-flight dispatched batches: (batch, output futures, t0).
+        self._pending: collections.deque = collections.deque()
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, ctx: typing.Optional["RuntimeContext"] = None) -> None:
@@ -66,12 +75,22 @@ class CompiledMethodRunner:
         self._params_on_device = jax.device_put(self.model.params, device)
 
         method = self.method
+        select = self.output_names
+
+        def prune(outputs):
+            if select is None:
+                return outputs
+            missing = set(select) - set(outputs)
+            if missing:
+                raise KeyError(f"method {method.name!r} has no outputs {missing}")
+            return {k: outputs[k] for k in select}
+
         if method.needs_lengths:
             def call(params, inputs, lengths):
-                return method.fn(params, inputs, lengths)
+                return prune(method.fn(params, inputs, lengths))
         else:
             def call(params, inputs):
-                return method.fn(params, inputs)
+                return prune(method.fn(params, inputs))
         # Inference outputs (logits/labels) never alias input image/token
         # buffers, so donation buys nothing here and XLA warns per bucket;
         # opt in only for methods whose outputs can reuse input pages.
@@ -93,12 +112,19 @@ class CompiledMethodRunner:
             self.run_batch([TensorValue(fields)] * b)
 
     def close(self) -> None:
+        self._pending.clear()
         self._params_on_device = None
         self._jit_fn = None
 
     # -- execution ---------------------------------------------------------
-    def run_batch(self, records: typing.Sequence[typing.Any]) -> typing.List[TensorValue]:
-        """Run one micro-batch; returns one output record per input record."""
+    def dispatch(self, records: typing.Sequence[typing.Any]) -> None:
+        """Assemble + transfer + launch one micro-batch WITHOUT blocking.
+
+        jax dispatch is async: the jitted call returns future-backed
+        arrays immediately, so the device crunches this batch while the
+        host assembles the next one.  Results are collected by
+        :meth:`collect_ready` / :meth:`flush`.
+        """
         if self._jit_fn is None:
             raise RuntimeError("runner not opened")
         t0 = time.monotonic()
@@ -113,7 +139,11 @@ class CompiledMethodRunner:
             outputs = self._jit_fn(self._params_on_device, inputs, lengths)
         else:
             outputs = self._jit_fn(self._params_on_device, inputs)
-        host = DeviceTransfer.fetch(outputs)
+        self._pending.append((batch, outputs, t0))
+
+    def _fetch_oldest(self) -> typing.List[TensorValue]:
+        batch, outputs, t0 = self._pending.popleft()
+        host = DeviceTransfer.fetch(outputs)  # blocks on this batch only
         results = batch.unbatch(host)
         if self._metrics is not None:
             dt = time.monotonic() - t0
@@ -123,3 +153,20 @@ class CompiledMethodRunner:
             self._metrics.counter("batches").inc()
             self._metrics.counter("padded_records").inc(batch.padded_size - batch.num_records)
         return results
+
+    def collect_ready(self, max_in_flight: int = 1) -> typing.List[TensorValue]:
+        """Drain completed batches until <= ``max_in_flight`` remain."""
+        out: typing.List[TensorValue] = []
+        while len(self._pending) > max_in_flight:
+            out.extend(self._fetch_oldest())
+        return out
+
+    def flush(self) -> typing.List[TensorValue]:
+        """Block for every in-flight batch (end of input / pre-snapshot)."""
+        return self.collect_ready(0)
+
+    def run_batch(self, records: typing.Sequence[typing.Any]) -> typing.List[TensorValue]:
+        """Synchronous micro-batch: dispatch + wait (single-record map and
+        tests; the windowed path pipelines via dispatch/collect_ready)."""
+        self.dispatch(records)
+        return self.flush()

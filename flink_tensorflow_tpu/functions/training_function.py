@@ -1,0 +1,300 @@
+"""Training as stream operators — online SGD and data-parallel gangs.
+
+Two training shapes from the reference (BASELINE.json:10-11):
+
+- **Online training on a keyed stream** (Wide&Deep): per-record/mini-batch
+  SGD inside a keyed ProcessFunction.  Reference mechanism: ``Session.run
+  (train_op)`` with variables hidden in the session (SURVEY.md §3.4).
+  Here :class:`OnlineTrainFunction` keeps the TrainState as EXPLICIT
+  function state, so checkpoint barriers snapshot params+optimizer
+  natively — the state-outside-snapshots caveat of the reference
+  (SURVEY.md §5 "Checkpoint / resume") disappears by construction.
+
+- **Data-parallel training** (ResNet-50): reference runs N replica
+  sessions + ClusterSpec/NCCL allreduce (SURVEY.md §3.5).  Here
+  :class:`DPTrainWindowFunction` is a *gang operator* (SURVEY.md §7 hard
+  part 4): parallelism 1 on the stream plane, owning the WHOLE device
+  mesh; each fired window becomes one pjit-ed step whose gradient
+  allreduce XLA emits over ICI.
+
+Snapshot protocol note: barriers never cut a jitted step in half — the
+operator processes elements one at a time and snapshots only between
+calls (SURVEY.md §7 hard part 5).  Snapshots are host-side numpy pytrees
+(device_get on snapshot, device_put on restore).
+"""
+
+from __future__ import annotations
+
+import typing
+
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.models.zoo.registry import ModelDef
+from flink_tensorflow_tpu.tensors.batching import BucketPolicy, assemble
+from flink_tensorflow_tpu.tensors.coercion import coerce
+from flink_tensorflow_tpu.tensors.schema import RecordSchema
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+
+def _to_host(pytree):
+    import jax
+    import numpy as np
+
+    def conv(a):
+        a = jax.device_get(a)
+        try:
+            return np.asarray(a)
+        except TypeError:
+            return a  # extended dtypes (PRNG keys) stay as jax arrays
+
+    return jax.tree.map(conv, pytree)
+
+
+def _train_batch_arrays(records, schema: RecordSchema, policy: BucketPolicy):
+    """Assemble training records -> batch dict incl. labels and lengths.
+
+    True lengths for dynamic fields are merged as ``<field>_len`` (the
+    loss_fn convention, e.g. bilstm's ``tokens_len``).  Training batches
+    are NOT padded with replay rows blindly: the batch is bucketed, and
+    pad rows replicate record 0 — with loss averaged over the bucket this
+    would bias gradients, so we weight via the valid mask when padding
+    occurred (callers see ``valid`` in the batch dict).
+    """
+    import numpy as np
+
+    tvs = [r if isinstance(r, TensorValue) else coerce(r, schema) for r in records]
+    batch = assemble(tvs, schema, policy)
+    arrays = dict(batch.arrays)
+    for name, lengths in batch.lengths.items():
+        arrays[f"{name}_len"] = lengths
+    arrays["valid"] = batch.valid.astype(np.float32)
+    return batch, arrays
+
+
+class OnlineTrainFunction(fn.ProcessFunction):
+    """Per-key (or per-subtask) online SGD on a keyed stream.
+
+    ``scope="subtask"`` (default): one TrainState per operator subtask —
+    keys partition the *data*, the model is shared within the subtask.
+    ``scope="key"``: one TrainState per key in keyed state — fully
+    personalized models (use small model configs).
+
+    Emits one metrics record per mini-batch:
+    ``TensorValue({"loss": ..., "step": ...}, meta={"key": key})``.
+    """
+
+    def __init__(
+        self,
+        model_def: ModelDef,
+        optimizer=None,
+        *,
+        train_schema: RecordSchema,
+        scope: str = "subtask",
+        mini_batch: int = 1,
+        seed: int = 0,
+    ):
+        if scope not in ("subtask", "key"):
+            raise ValueError(f"scope must be 'subtask' or 'key', got {scope!r}")
+        self.model_def = model_def
+        self.optimizer = optimizer
+        self.train_schema = train_schema
+        self.scope = scope
+        self.mini_batch = mini_batch
+        self.seed = seed
+        self._step_fn = None
+        self._state = None  # subtask scope
+        self._key_state = None  # key scope (ValueState)
+        self._buffers: typing.Dict[typing.Any, list] = {}
+        self._policy = BucketPolicy(fixed_batch=mini_batch)
+
+    def clone(self):
+        import copy
+
+        dup = copy.copy(self)
+        dup._step_fn = None
+        dup._state = None
+        dup._key_state = None
+        dup._buffers = {}
+        return dup
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self, ctx) -> None:
+        import jax
+        import optax
+
+        from flink_tensorflow_tpu.parallel.dp import init_train_state, make_train_step
+
+        self.ctx = ctx
+        optimizer = self.optimizer or optax.sgd(0.01)
+        self.optimizer = optimizer
+        self._step_fn = jax.jit(make_train_step(self.model_def, optimizer))
+        self._init = lambda: init_train_state(
+            self.model_def, optimizer,
+            jax.random.fold_in(jax.random.key(self.seed), ctx.subtask_index),
+        )
+        if self.scope == "subtask":
+            if self._state is None:  # not restored
+                self._state = self._init()
+        else:
+            from flink_tensorflow_tpu.core.state import StateDescriptor
+
+            self._key_state = ctx.state(StateDescriptor("train_state"))
+
+    # -- processing --------------------------------------------------------
+    def process_element(self, value, ctx, out: fn.Collector) -> None:
+        key = ctx.current_key
+        buf = self._buffers.setdefault(key, [])
+        buf.append(value)
+        if len(buf) >= self.mini_batch:
+            self._buffers[key] = []
+            self._train(key, buf, out)
+
+    def on_finish(self, out: fn.Collector) -> None:
+        """Flush partial mini-batches: the valid-mask-weighted loss keeps
+        pad rows out of the gradient, so short batches train correctly."""
+        for key, buf in list(self._buffers.items()):
+            if buf:
+                self._buffers[key] = []
+                self._train(key, buf, out)
+
+    def _train(self, key, records, out: fn.Collector) -> None:
+        import numpy as np
+
+        import contextlib
+
+        _, arrays = _train_batch_arrays(records, self.train_schema, self._policy)
+        # Scope keyed state to THIS key (on_finish flushes several keys
+        # outside the per-element current-key window).
+        scope = self.ctx.with_key(key) if self.scope == "key" else contextlib.nullcontext()
+        with scope:
+            if self.scope == "key":
+                state = self._key_state.value()
+                if state is None:
+                    state = self._init()
+            else:
+                state = self._state
+            state, metrics = self._step_fn(state, arrays)
+            if self.scope == "key":
+                self._key_state.update(state)
+            else:
+                self._state = state
+        host = {k: np.asarray(v) for k, v in metrics.items()}
+        host["step"] = np.asarray(int(state["step"]), np.int64)
+        out.collect(TensorValue(host, meta={"key": key}))
+        if self.ctx is not None:
+            self.ctx.metrics.meter("train_records").mark(len(records))
+            self.ctx.metrics.counter("train_steps").inc()
+
+    # -- snapshot (params ARE operator state) ------------------------------
+    def snapshot_state(self):
+        # Keyed scope rides the KeyedStateStore snapshot automatically;
+        # subtask scope snapshots its TrainState + open mini-batches here.
+        # Deep-copy buffer lists: the snapshot is acked by reference, and
+        # post-barrier appends must not leak into it (exactly-once).
+        return {
+            "state": _to_host(self._state) if self._state is not None else None,
+            "buffers": {k: list(v) for k, v in self._buffers.items()},
+        }
+
+    def restore_state(self, snap) -> None:
+        self._state = snap["state"]
+        self._buffers = {k: list(v) for k, v in snap["buffers"].items()}
+
+    def current_params(self, key=None):
+        """Latest variables (for export via models.save_bundle)."""
+        if self.scope == "key":
+            raise ValueError("pass through keyed state for per-key params")
+        return _to_host(self._state["variables"])
+
+
+class DPTrainWindowFunction(fn.WindowFunction):
+    """Gang operator: each fired window = one DP train step on the mesh.
+
+    Use with parallelism=1 — the gang owns every chip via ``env.set_mesh``
+    (SURVEY.md §7 hard part 4: "DP training wants one jitted step spanning
+    all chips").  The window size is the global batch; it is padded to the
+    fixed ``global_batch`` (must divide by the mesh's data axis).
+    """
+
+    def __init__(
+        self,
+        model_def: ModelDef,
+        optimizer=None,
+        *,
+        train_schema: RecordSchema,
+        global_batch: int,
+        seed: int = 0,
+    ):
+        self.model_def = model_def
+        self.optimizer = optimizer
+        self.train_schema = train_schema
+        self.global_batch = global_batch
+        self.seed = seed
+        self._step_fn = None
+        self._state = None
+        self._restored = None
+        self._policy = BucketPolicy(fixed_batch=global_batch)
+        self.mesh = None
+
+    def clone(self):
+        import copy
+
+        dup = copy.copy(self)
+        dup._step_fn = None
+        dup._state = None
+        return dup
+
+    def open(self, ctx) -> None:
+        import jax
+        import optax
+
+        from flink_tensorflow_tpu.parallel.dp import init_train_state, make_dp_train_step
+        from flink_tensorflow_tpu.parallel.mesh import replicate
+
+        if ctx.mesh is None:
+            raise RuntimeError(
+                "DPTrainWindowFunction needs env.set_mesh(...) — the gang owns the mesh"
+            )
+        if ctx.parallelism != 1:
+            raise RuntimeError("gang operator must run with parallelism=1")
+        self.ctx = ctx
+        self.mesh = ctx.mesh
+        data_size = self.mesh.shape.get("data", 1)
+        if self.global_batch % data_size:
+            raise ValueError(
+                f"global_batch {self.global_batch} must be divisible by the "
+                f"data-axis size {data_size}"
+            )
+        optimizer = self.optimizer or optax.sgd(0.01)
+        self.optimizer = optimizer
+        self._step_fn = make_dp_train_step(self.model_def, optimizer, self.mesh)
+        state = self._restored or init_train_state(
+            self.model_def, optimizer, jax.random.key(self.seed)
+        )
+        self._restored = None
+        self._state = replicate(self.mesh, state)
+
+    def process_window(self, key, window, elements, out: fn.Collector) -> None:
+        import numpy as np
+
+        from flink_tensorflow_tpu.parallel.mesh import shard_batch
+
+        _, arrays = _train_batch_arrays(list(elements), self.train_schema, self._policy)
+        batch = shard_batch(self.mesh, arrays)
+        self._state, metrics = self._step_fn(self._state, batch)
+        host = {k: np.asarray(v) for k, v in metrics.items()}
+        host["step"] = np.asarray(int(self._state["step"]), np.int64)
+        out.collect(TensorValue(host))
+        self.ctx.metrics.meter("train_records").mark(len(elements))
+        self.ctx.metrics.counter("train_steps").inc()
+
+    def snapshot_state(self):
+        return {"state": _to_host(self._state) if self._state is not None else None}
+
+    def restore_state(self, snap) -> None:
+        # open() runs after restore in the operator lifecycle? No: restore
+        # happens before start, open() on the subtask thread — stash and
+        # let open() place it on the mesh.
+        self._restored = snap["state"]
+
+    def current_params(self):
+        return _to_host(self._state["variables"])

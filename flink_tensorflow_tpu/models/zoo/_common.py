@@ -1,0 +1,18 @@
+"""Shared helpers for the zoo model definitions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def weighted_metrics(per_example_loss, per_example_hit, valid):
+    """Batch-pad-aware loss/accuracy reduction shared by all zoo loss_fns.
+
+    ``valid`` is the batcher's [B] 0/1 mask (tensors.batching) — pad rows
+    replay real records, so without the mask they would bias gradients.
+    """
+    if valid is None:
+        return per_example_loss.mean(), per_example_hit.mean()
+    w = valid.astype(per_example_loss.dtype)
+    denom = jnp.maximum(w.sum(), 1.0)
+    return (per_example_loss * w).sum() / denom, (per_example_hit * w).sum() / denom

@@ -68,10 +68,13 @@ def build(vocab_size: int = 20000, embed_dim: int = 128, hidden_dim: int = 256,
     def loss_fn(variables, batch, rng):
         import optax
 
+        from flink_tensorflow_tpu.models.zoo._common import weighted_metrics
+
         logits = module.apply(variables, batch["tokens"], batch["tokens_len"])
         labels = batch["label"]
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
-        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        hits = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        loss, acc = weighted_metrics(per_ex, hits, batch.get("valid"))
         return loss, ({}, {"loss": loss, "accuracy": acc})
 
     methods = {

@@ -60,10 +60,15 @@ def build(num_classes: int = 10, image_size: int = 28, channels: int = 1) -> Mod
         return module.init(rng, jnp.zeros((1, image_size, image_size, channels)))
 
     def loss_fn(variables, batch, rng):
+        import optax
+
+        from flink_tensorflow_tpu.models.zoo._common import weighted_metrics
+
         logits = module.apply(variables, batch["image"])
         labels = batch["label"]
-        loss = optax_softmax_ce(logits, labels)
-        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        hits = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        loss, acc = weighted_metrics(per_ex, hits, batch.get("valid"))
         return loss, ({}, {"loss": loss, "accuracy": acc})
 
     methods = {
@@ -86,7 +91,3 @@ def build(num_classes: int = 10, image_size: int = 28, channels: int = 1) -> Mod
     )
 
 
-def optax_softmax_ce(logits, labels):
-    import optax
-
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()

@@ -93,12 +93,15 @@ def build(num_classes: int = 1000, image_size: int = 224, width: int = 64,
     def loss_fn(variables, batch, rng):
         import optax
 
+        from flink_tensorflow_tpu.models.zoo._common import weighted_metrics
+
         logits, new_state = module.apply(
             variables, batch["image"], train=True, mutable=["batch_stats"],
         )
         labels = batch["label"]
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
-        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        hits = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        loss, acc = weighted_metrics(per_ex, hits, batch.get("valid"))
         return loss, (new_state, {"loss": loss, "accuracy": acc})
 
     methods = {

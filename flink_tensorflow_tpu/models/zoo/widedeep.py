@@ -76,10 +76,13 @@ def build(hash_buckets: int = 100_000, embed_dim: int = 32, num_cat_slots: int =
     def loss_fn(variables, batch, rng):
         import optax
 
+        from flink_tensorflow_tpu.models.zoo._common import weighted_metrics
+
         logit = module.apply(variables, batch["wide"], batch["dense"], batch["cat"])
         label = batch["label"].astype(jnp.float32)
-        loss = optax.sigmoid_binary_cross_entropy(logit, label).mean()
-        acc = jnp.mean(((logit > 0) == (label > 0.5)).astype(jnp.float32))
+        per_ex = optax.sigmoid_binary_cross_entropy(logit, label)
+        hits = ((logit > 0) == (label > 0.5)).astype(jnp.float32)
+        loss, acc = weighted_metrics(per_ex, hits, batch.get("valid"))
         return loss, ({}, {"loss": loss, "accuracy": acc})
 
     methods = {

@@ -1,0 +1,257 @@
+"""TensorRing — schema-typed zero-copy record ring over the native arena.
+
+One producer thread writes records field-by-field into a reserved slot;
+one consumer thread claims N contiguous slots and gets the batch as
+``[N, ...]`` numpy views ONTO the arena — no stacking copy.  Feed those
+views straight to ``jax.device_put`` and the host-side cost of batch
+assembly drops to the producer's single record write (the
+"zero-copy Row<->DeviceArray marshalling" of BASELINE.json's north star).
+
+The consumer must finish with the views (i.e. after ``device_put``
+returns) before calling :meth:`release`, which recycles the slots.
+
+Falls back to a lock-based Python ring (same API, same contiguity
+guarantees) when the native library isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import typing
+
+import numpy as np
+
+from flink_tensorflow_tpu.tensors.schema import RecordSchema
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _lib_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "native", "lib", "libftt_native.so")
+
+
+def _load_lib():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    lib.ring_create.restype = ctypes.c_void_p
+    lib.ring_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.ring_destroy.argtypes = [ctypes.c_void_p]
+    lib.ring_arena.restype = ctypes.c_void_p
+    lib.ring_arena.argtypes = [ctypes.c_void_p]
+    lib.ring_slot_size.restype = ctypes.c_uint64
+    lib.ring_slot_size.argtypes = [ctypes.c_void_p]
+    lib.ring_capacity.restype = ctypes.c_uint64
+    lib.ring_capacity.argtypes = [ctypes.c_void_p]
+    lib.ring_push_reserve.restype = ctypes.c_int64
+    lib.ring_push_reserve.argtypes = [ctypes.c_void_p]
+    lib.ring_push_commit.argtypes = [ctypes.c_void_p]
+    lib.ring_poppable.restype = ctypes.c_uint64
+    lib.ring_poppable.argtypes = [ctypes.c_void_p]
+    lib.ring_pop_claim.restype = ctypes.c_uint64
+    lib.ring_pop_claim.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.ring_pop_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+def _field_layout(schema: RecordSchema, length_bucket: int):
+    """(offset, shape, dtype) per field within one slot + slot byte size.
+    Offsets are 64-byte aligned so batched views stay well-aligned."""
+    layout = {}
+    offset = 0
+    shapes = schema.resolve_dynamic(length_bucket)
+    for name in schema.names:
+        spec = schema[name]
+        shape = shapes[name]
+        nbytes = int(np.prod(shape)) * np.dtype(spec.dtype).itemsize if shape else np.dtype(spec.dtype).itemsize
+        layout[name] = (offset, shape, np.dtype(spec.dtype))
+        offset += (nbytes + 63) & ~63
+    return layout, offset
+
+
+class _PyRing:
+    """Fallback: same SPSC semantics with a mutex (correct, not lock-free)."""
+
+    def __init__(self, slot_size: int, n_slots: int):
+        pow2 = 1
+        while pow2 < n_slots:
+            pow2 *= 2
+        self.slot_size = slot_size
+        self.n_slots = pow2
+        self.mask = pow2 - 1
+        self.arena = np.zeros(slot_size * pow2, np.uint8)
+        self.head = 0
+        self.tail = 0
+        self._lock = threading.Lock()
+
+    def push_reserve(self) -> int:
+        with self._lock:
+            if self.tail - self.head >= self.n_slots:
+                return -1
+            return self.tail & self.mask
+
+    def push_commit(self) -> None:
+        with self._lock:
+            self.tail += 1
+
+    def poppable(self) -> int:
+        with self._lock:
+            return self.tail - self.head
+
+    def pop_claim(self, max_n: int) -> typing.Tuple[int, int]:
+        with self._lock:
+            ready = self.tail - self.head
+            if ready == 0:
+                return 0, 0
+            idx = self.head & self.mask
+            n = min(ready, max_n, self.n_slots - idx)
+            return idx, n
+
+    def pop_release(self, count: int) -> None:
+        with self._lock:
+            self.head += count
+
+    def arena_view(self) -> np.ndarray:
+        return self.arena
+
+    def destroy(self) -> None:
+        pass
+
+
+class _NativeRing:
+    def __init__(self, slot_size: int, n_slots: int):
+        self._lib = _load_lib()
+        self._ptr = self._lib.ring_create(slot_size, n_slots)
+        if not self._ptr:
+            raise MemoryError("ring_create failed")
+        self.slot_size = self._lib.ring_slot_size(self._ptr)
+        self.n_slots = self._lib.ring_capacity(self._ptr)
+        nbytes = self.slot_size * self.n_slots
+        base = self._lib.ring_arena(self._ptr)
+        self._arena = np.ctypeslib.as_array(
+            (ctypes.c_uint8 * nbytes).from_address(base)
+        )
+
+    def push_reserve(self) -> int:
+        return self._lib.ring_push_reserve(self._ptr)
+
+    def push_commit(self) -> None:
+        self._lib.ring_push_commit(self._ptr)
+
+    def poppable(self) -> int:
+        return self._lib.ring_poppable(self._ptr)
+
+    def pop_claim(self, max_n: int) -> typing.Tuple[int, int]:
+        start = ctypes.c_uint64()
+        n = self._lib.ring_pop_claim(self._ptr, max_n, ctypes.byref(start))
+        return int(start.value), int(n)
+
+    def pop_release(self, count: int) -> None:
+        self._lib.ring_pop_release(self._ptr, count)
+
+    def arena_view(self) -> np.ndarray:
+        return self._arena
+
+    def destroy(self) -> None:
+        if self._ptr:
+            self._lib.ring_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+class TensorRing:
+    """Schema-typed SPSC record ring with zero-copy batch views."""
+
+    def __init__(
+        self,
+        schema: RecordSchema,
+        capacity: int = 256,
+        *,
+        length_bucket: int = 128,
+        native: typing.Optional[bool] = None,
+    ):
+        self.schema = schema
+        self.layout, self.slot_size = _field_layout(schema, length_bucket)
+        if native is None:
+            native = native_available()
+        elif native and not native_available():
+            raise RuntimeError("native ring requested but libftt_native.so not built "
+                               "(run: make -C native)")
+        self.is_native = bool(native)
+        ring_cls = _NativeRing if self.is_native else _PyRing
+        self._ring = ring_cls(self.slot_size, capacity)
+        self.capacity = self._ring.n_slots
+
+    # -- producer ----------------------------------------------------------
+    def try_push(self, record: typing.Mapping[str, np.ndarray]) -> bool:
+        """Write one record into the ring; False if full (caller backs off)."""
+        slot = self._ring.push_reserve()
+        if slot < 0:
+            return False
+        arena = self._ring.arena_view()
+        base = slot * self.slot_size
+        for name, (offset, shape, dtype) in self.layout.items():
+            dst = np.frombuffer(
+                arena.data, dtype=dtype, count=int(np.prod(shape)) if shape else 1,
+                offset=base + offset,
+            ).reshape(shape)
+            src = np.asarray(record[name])
+            if src.shape != tuple(shape):  # dynamic field: write prefix, zero-pad
+                dst.fill(0)
+                dst[tuple(slice(0, s) for s in src.shape)] = src
+            else:
+                dst[...] = src
+        self._ring.push_commit()
+        return True
+
+    # -- consumer ----------------------------------------------------------
+    def poppable(self) -> int:
+        return self._ring.poppable()
+
+    def claim_batch(self, max_n: int) -> typing.Tuple[typing.Dict[str, np.ndarray], int]:
+        """Claim up to ``max_n`` contiguous records; returns ({field ->
+        [n, ...] zero-copy view}, n).  Call :meth:`release` when done."""
+        start, n = self._ring.pop_claim(max_n)
+        if n == 0:
+            return {}, 0
+        arena = self._ring.arena_view()
+        views = {}
+        for name, (offset, shape, dtype) in self.layout.items():
+            elems = int(np.prod(shape)) if shape else 1
+            # Strided view over the claimed slots: axis 0 strides by the
+            # slot size, the field itself is contiguous within each slot.
+            flat = np.ndarray(
+                (n, elems),
+                dtype=dtype,
+                buffer=arena.data,
+                offset=start * self.slot_size + offset,
+                strides=(self.slot_size, dtype.itemsize),
+            )
+            views[name] = flat.reshape((n, *shape)) if shape else flat.reshape((n,))
+        return views, n
+
+    def release(self, count: int) -> None:
+        self._ring.pop_release(count)
+
+    def close(self) -> None:
+        self._ring.destroy()

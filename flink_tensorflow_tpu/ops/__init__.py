@@ -1,0 +1,6 @@
+"""Custom TPU kernels (pallas) for hot ops the XLA graph path can't fuse
+optimally — see /opt/skills/guides/pallas_guide.md conventions."""
+
+from flink_tensorflow_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

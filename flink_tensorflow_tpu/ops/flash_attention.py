@@ -1,0 +1,142 @@
+"""Flash attention — pallas TPU kernel for the long-sequence hot path.
+
+The reference has no attention at all (its sequence model is a BiLSTM,
+SURVEY.md §5 "Long-context": absent); this framework treats long-context
+as first-class, so the O(T^2)-memory-free attention primitive ships as a
+native TPU kernel (pallas) rather than a composed jnp graph:
+
+- one grid program per (batch*head, q-block): the q block and the
+  f32 accumulators live in VMEM; K/V stream through in ``block_k`` tiles
+- online softmax (running max/denominator) — no [T, T] score matrix ever
+  materializes in HBM
+- ``jnp.dot(..., preferred_element_type=f32)`` keeps both matmuls on the
+  MXU with f32 accumulation over bf16 inputs
+- causal grids skip fully-masked K/V tiles entirely (upper-triangle
+  blocks are never read)
+
+Composes with the ``seq``-axis ring (parallel/ring_attention.py): ring
+moves K/V shards BETWEEN chips over ICI, this kernel computes each local
+block WITHIN a chip.  On non-TPU backends the kernel runs in interpreter
+mode (tests) — same code path, no hand-written fallback to drift.
+"""
+
+from __future__ import annotations
+
+import math
+import typing
+
+
+def flash_attention(
+    q, k, v,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: typing.Optional[bool] = None,
+):
+    """Attention over ``[B, T, H, D]`` tensors (same layout/semantics as
+    parallel.full_attention).  Block sizes shrink automatically for short
+    sequences; the stream layer's power-of-two buckets keep them aligned."""
+    import jax
+
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    block_q = math.gcd(block_q, t)
+    block_k = math.gcd(block_k, tk)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head).
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    out = _flash_bh(
+        to_bh(q), to_bh(k), to_bh(v),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    nq, nk = t // block_q, tk // block_k
+    scale = 1.0 / math.sqrt(d)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        # Grid (bh, nq, nk): the innermost k dimension iterates
+        # sequentially on TPU, so the VMEM scratch accumulators carry the
+        # online softmax across K/V tiles — only ONE (block_k, d) K and V
+        # tile is resident at a time, so VMEM use is O(block) not O(T).
+        qi = pl.program_id(1)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _init():
+            m_scr[:] = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+            l_scr[:] = jnp.zeros((block_q, 1), jnp.float32)
+            acc_scr[:] = jnp.zeros((block_q, d), jnp.float32)
+
+        # Causal: tiles strictly above the diagonal contribute nothing.
+        visible = True if not causal else (j * block_k <= qi * block_q + block_q - 1)
+
+        @pl.when(visible)
+        def _update():
+            q_blk = q_ref[0].astype(jnp.float32) * scale       # [bq, d]
+            k_blk = k_ref[0].astype(jnp.float32)               # [bk, d]
+            v_blk = v_ref[0].astype(jnp.float32)
+            s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+            m = m_scr[:, 0]
+            l = l_scr[:, 0]
+            m_blk = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            # Fully-masked rows keep m_new = -inf: guard the exps so they
+            # contribute 0 instead of NaN.
+            safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+            p = jnp.exp(s - safe_m[:, None])
+            p = jnp.where(jnp.isinf(m_new)[:, None] | jnp.isinf(s), 0.0, p)
+            alpha = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - safe_m))
+            m_scr[:] = m_new[:, None]
+            l_scr[:] = (l * alpha + jnp.sum(p, axis=-1))[:, None]
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.dot(
+                p, v_blk, preferred_element_type=jnp.float32)
+
+        @pl.when(j == nk - 1)
+        def _finalize():
+            l = l_scr[:, 0]
+            denom = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, qi, j: (b_, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, qi, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, qi, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, qi, j: (b_, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(fn)(q, k, v)

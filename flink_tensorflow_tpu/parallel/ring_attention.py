@@ -77,21 +77,26 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool =
     # on this device originated at rank (my - i) mod n.
     perm = [(j, (j + 1) % n) for j in range(n)]
 
+    def mask_for(step):
+        if not causal:
+            return None
+        src = (my - step) % n
+        q_pos = my * t + jnp.arange(t)[:, None]
+        k_pos = src * t + jnp.arange(t)[None, :]
+        return k_pos <= q_pos
+
     def body(i, carry):
+        # Rotate at the TOP so the last block's attention isn't followed by
+        # a dead K/V exchange (n-1 ppermutes total, not n).
         k_blk, v_blk, m, l, o = carry
-        src = (my - i) % n
-        if causal:
-            q_pos = my * t + jnp.arange(t)[:, None]
-            k_pos = src * t + jnp.arange(t)[None, :]
-            mask = k_pos <= q_pos
-        else:
-            mask = None
-        m, l, o = _block_attention(qf, k_blk, v_blk, m, l, o, mask)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
+        m, l, o = _block_attention(qf, k_blk, v_blk, m, l, o, mask_for(i))
         return k_blk, v_blk, m, l, o
 
-    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    # Peel step 0 (local K/V, no exchange), ring through the remaining n-1.
+    m0, l0, o0 = _block_attention(qf, k, v, m0, l0, o0, mask_for(0))
+    _, _, m, l, o = lax.fori_loop(1, n, body, (k, v, m0, l0, o0))
     # Fully-masked rows (can happen only with exotic masks) -> 0, not NaN.
     denom = jnp.where(l == 0.0, 1.0, l)
     out = o / denom.transpose(0, 2, 1)[..., None]

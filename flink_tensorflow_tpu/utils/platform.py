@@ -1,0 +1,34 @@
+"""Platform selection shared by every entry point (bench, graft hooks,
+examples, tests).
+
+Forcing CPU needs BOTH the env var and the jax.config update: the axon
+PJRT plugin (the tunneled TPU) re-registers itself as the default
+platform even when ``JAX_PLATFORMS=cpu`` is set before import.  Keep the
+workaround in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(virtual_devices: int = 8) -> None:
+    """Pin jax to the CPU backend with N virtual devices.  Safe to call
+    before OR after jax import, but before any backend-touching call."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={virtual_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compile_cache(path: str = "/tmp/ftt_xla_cache") -> None:
+    """Persistent XLA compile cache — repeat runs skip big compiles."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

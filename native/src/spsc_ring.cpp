@@ -1,0 +1,118 @@
+// SPSC ring arena — the native data plane for tensor records.
+//
+// The reference's data plane is Flink's Netty shuffle (C/JVM native,
+// SURVEY.md §2 "Distributed communication backend"); this is the
+// TPU-framework equivalent for the in-process hop between a stream
+// subtask and a model operator: a lock-free single-producer /
+// single-consumer ring of fixed-size record slots backed by one
+// contiguous arena.
+//
+// The point is zero-copy batch assembly (BASELINE.json north_star:
+// "zero-copy Row<->DeviceArray marshalling"): the producer writes each
+// record's tensor bytes directly into its slot; the consumer claims N
+// CONTIGUOUS slots at once, and the Python side wraps them as one
+// [N, ...] numpy view — the batch that jax.device_put ships to HBM with
+// no intermediate stacking copy.
+//
+// Memory model: standard C++11 acquire/release SPSC queue.  head_ is
+// only written by the consumer, tail_ only by the producer.  Slot
+// payloads are published by the release store to tail_ and observed via
+// the acquire load in ring_poppable().
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+struct Ring {
+  uint64_t slot_size;   // bytes per record slot
+  uint64_t n_slots;     // power of two
+  uint64_t mask;        // n_slots - 1
+  uint8_t* arena;       // slot_size * n_slots bytes
+  alignas(64) std::atomic<uint64_t> head;  // next slot to consume
+  alignas(64) std::atomic<uint64_t> tail;  // next slot to produce
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a ring with n_slots (rounded up to a power of two) of slot_size
+// bytes.  Returns nullptr on allocation failure.
+Ring* ring_create(uint64_t slot_size, uint64_t n_slots) {
+  uint64_t pow2 = 1;
+  while (pow2 < n_slots) pow2 <<= 1;
+  Ring* r = new (std::nothrow) Ring();
+  if (!r) return nullptr;
+  r->slot_size = slot_size;
+  r->n_slots = pow2;
+  r->mask = pow2 - 1;
+  // 64-byte alignment: slot 0 starts cacheline-aligned, and typical
+  // record shapes keep rows well-aligned for the numpy views.
+  r->arena = static_cast<uint8_t*>(aligned_alloc(64, slot_size * pow2));
+  if (!r->arena) {
+    delete r;
+    return nullptr;
+  }
+  r->head.store(0, std::memory_order_relaxed);
+  r->tail.store(0, std::memory_order_relaxed);
+  return r;
+}
+
+void ring_destroy(Ring* r) {
+  if (!r) return;
+  free(r->arena);
+  delete r;
+}
+
+uint8_t* ring_arena(Ring* r) { return r->arena; }
+uint64_t ring_slot_size(Ring* r) { return r->slot_size; }
+uint64_t ring_capacity(Ring* r) { return r->n_slots; }
+
+// Producer: reserve the next slot for writing.  Returns the slot index
+// (0..n_slots-1) or -1 if the ring is full.  The producer must write the
+// payload into the slot and then call ring_push_commit exactly once.
+int64_t ring_push_reserve(Ring* r) {
+  uint64_t tail = r->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->head.load(std::memory_order_acquire);
+  if (tail - head >= r->n_slots) return -1;  // full
+  return static_cast<int64_t>(tail & r->mask);
+}
+
+// Producer: publish the reserved slot (payload must be fully written).
+void ring_push_commit(Ring* r) {
+  r->tail.fetch_add(1, std::memory_order_release);
+}
+
+// Consumer: how many records are ready.
+uint64_t ring_poppable(Ring* r) {
+  uint64_t tail = r->tail.load(std::memory_order_acquire);
+  uint64_t head = r->head.load(std::memory_order_relaxed);
+  return tail - head;
+}
+
+// Consumer: claim up to max_n ready records as one CONTIGUOUS run of
+// slots (stops at the arena wrap point).  Writes the first slot index to
+// *start and returns the claimed count (0 if empty).  The claimed slots
+// stay valid until ring_pop_release(count).
+uint64_t ring_pop_claim(Ring* r, uint64_t max_n, uint64_t* start) {
+  uint64_t ready = ring_poppable(r);
+  if (ready == 0) return 0;
+  uint64_t head = r->head.load(std::memory_order_relaxed);
+  uint64_t idx = head & r->mask;
+  uint64_t until_wrap = r->n_slots - idx;
+  uint64_t n = ready < max_n ? ready : max_n;
+  if (n > until_wrap) n = until_wrap;
+  *start = idx;
+  return n;
+}
+
+// Consumer: free the claimed slots for reuse.
+void ring_pop_release(Ring* r, uint64_t count) {
+  r->head.fetch_add(count, std::memory_order_release);
+}
+
+}  // extern "C"

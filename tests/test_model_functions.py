@@ -69,6 +69,20 @@ class TestModelWindowFunction:
         got = {r.meta["i"]: int(r["label"]) for r in results}
         assert got == {i: l for i, l in enumerate(expected_labels)}
 
+    def test_pipelined_dispatch_completeness(self, lenet_model, images, expected_labels):
+        """pipeline_depth=3: in-flight batches must all flush at end of
+        input — every record exactly once, labels correct."""
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images)
+            .count_window(2)
+            .apply(ModelWindowFunction(lenet_model, pipeline_depth=3))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        got = {r.meta["i"]: int(r["label"]) for r in results}
+        assert got == {i: l for i, l in enumerate(expected_labels)}
+
     def test_oversized_window_chunks(self, lenet_model, images, expected_labels):
         env = StreamExecutionEnvironment(parallelism=1)
         results = (

@@ -60,6 +60,20 @@ class TestZoo:
         assert out["logits"].shape == (1, 10)
         assert float(out["score"][0]) <= 1.0
 
+    def test_inception_uint8_matches_prescaled_float(self, rng):
+        """uint8 ingestion + on-device normalize == float ingestion of the
+        same normalized pixels (the 4x-transfer-saving path is lossless
+        up to bf16 rounding)."""
+        mdef8 = get_model_def("inception_v3", num_classes=5, uint8_input=True)
+        mdeff = get_model_def("inception_v3", num_classes=5)
+        params = init_jit(mdef8, rng)
+        img8 = np.random.RandomState(0).randint(0, 256, (1, 299, 299, 3)).astype(np.uint8)
+        imgf = img8.astype(np.float32) / 127.5 - 1.0
+        out8 = jax.jit(mdef8.methods["serve"].fn)(params, {"image": jnp.asarray(img8)})
+        outf = jax.jit(mdeff.methods["serve"].fn)(params, {"image": jnp.asarray(imgf)})
+        np.testing.assert_allclose(np.asarray(out8["logits"]),
+                                   np.asarray(outf["logits"]), atol=0.25)
+
     def test_bilstm_padding_invariance(self, rng):
         """Same sequence padded to different buckets -> same logits: the
         masking contract dynamic batching relies on (BASELINE.json:9)."""

@@ -1,0 +1,41 @@
+"""Pallas kernel tests (interpreter mode on CPU — same code path that
+compiles on TPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flink_tensorflow_tpu.ops import flash_attention
+from flink_tensorflow_tpu.parallel import full_attention
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        rng = np.random.RandomState(0)
+        b, t, h, d = 2, 64, 2, 16
+        q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+        want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+        got = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal, block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_odd_block_sizes_shrink(self):
+        rng = np.random.RandomState(1)
+        b, t, h, d = 1, 24, 1, 8  # 24 not divisible by 128 -> gcd blocks
+        q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+        want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        got = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_bfloat16_inputs(self):
+        rng = np.random.RandomState(2)
+        b, t, h, d = 1, 32, 2, 16
+        q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16) for _ in range(3))
+        want = full_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32), atol=3e-2)
