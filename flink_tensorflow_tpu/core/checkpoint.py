@@ -48,6 +48,12 @@ class CheckpointCoordinator:
         #: complete checkpoints racing with job completion.
         self._final_snapshots: typing.Dict[typing.Tuple[str, int], typing.Any] = {}
 
+    def resume_from(self, checkpoint_id: int) -> None:
+        """Continue numbering after a restored checkpoint so new snapshots
+        never overwrite the restore point."""
+        with self._lock:
+            self._next_id = max(self._next_id, checkpoint_id + 1)
+
     # -- trigger ----------------------------------------------------------
     def trigger(self, timeout: float = 60.0) -> typing.Dict[str, typing.Dict[int, typing.Any]]:
         """Run one aligned checkpoint; returns {task: {subtask: snapshot}}."""

@@ -9,6 +9,8 @@ providing the global device mesh (flink_tensorflow_tpu.parallel.multihost).
 
 from __future__ import annotations
 
+import dataclasses
+import time
 import typing
 
 from flink_tensorflow_tpu.core import functions as fn
@@ -21,8 +23,22 @@ from flink_tensorflow_tpu.metrics.registry import MetricRegistry
 
 
 class JobResult:
-    def __init__(self, metrics: typing.Dict[str, typing.Any]):
+    def __init__(self, metrics: typing.Dict[str, typing.Any], restarts: int = 0):
         self.metrics = metrics
+        self.restarts = restarts
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartStrategy:
+    """Flink-style fixed-delay restart (SURVEY.md §5 "Failure detection /
+    elastic recovery"): on job failure, rebuild the executor, restore the
+    latest snapshot from the checkpoint dir, and replay from the source
+    offsets.  Operator/keyed state is exactly-once; sink emissions for
+    replayed records are at-least-once (standard non-transactional sinks).
+    """
+
+    max_restarts: int = 3
+    delay_s: float = 0.0
 
 
 class JobHandle:
@@ -52,6 +68,7 @@ class StreamExecutionEnvironment:
         self.graph = DataflowGraph()
         self.default_parallelism = parallelism
         self.checkpoint_dir: typing.Optional[str] = None
+        self.checkpoint_interval_s: typing.Optional[float] = None
         self.channel_capacity = 1024
         self.device_provider: typing.Optional[typing.Callable[[str, int], typing.Any]] = None
         self.mesh: typing.Optional[typing.Any] = None
@@ -64,8 +81,14 @@ class StreamExecutionEnvironment:
         self.default_parallelism = parallelism
         return self
 
-    def enable_checkpointing(self, checkpoint_dir: str) -> "StreamExecutionEnvironment":
+    def enable_checkpointing(
+        self, checkpoint_dir: str, interval_s: typing.Optional[float] = None
+    ) -> "StreamExecutionEnvironment":
+        """Persist aligned snapshots under ``checkpoint_dir``; with
+        ``interval_s`` they trigger periodically (Flink's checkpoint
+        interval), otherwise only on explicit ``trigger_checkpoint``."""
         self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval_s = interval_s
         return self
 
     def set_device_provider(
@@ -117,12 +140,55 @@ class StreamExecutionEnvironment:
         timeout: typing.Optional[float] = None,
         restore_from: typing.Optional[str] = None,
         restore_checkpoint_id: typing.Optional[int] = None,
+        restart_strategy: typing.Optional[RestartStrategy] = None,
     ) -> JobResult:
-        """Run the job to completion on the local executor."""
-        handle = self.execute_async(
-            job_name, restore_from=restore_from, restore_checkpoint_id=restore_checkpoint_id
-        )
-        return handle.wait(timeout)
+        """Run the job to completion on the local executor.
+
+        With a ``restart_strategy`` (requires ``enable_checkpointing``),
+        failures restart the job from the latest persisted snapshot — the
+        supervisor role Flink's JobManager plays (SURVEY.md §5).
+        """
+        from flink_tensorflow_tpu.core.runtime import JobFailure, JobTimeout
+
+        if restart_strategy is None:
+            handle = self.execute_async(
+                job_name, restore_from=restore_from,
+                restore_checkpoint_id=restore_checkpoint_id,
+            )
+            return handle.wait(timeout)
+
+        if self.checkpoint_dir is None:
+            raise ValueError("restart_strategy requires enable_checkpointing(dir)")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempt = 0
+        restore = restore_from
+        restore_id = restore_checkpoint_id
+        while True:
+            remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
+            try:
+                handle = self.execute_async(job_name, restore_from=restore,
+                                            restore_checkpoint_id=restore_id)
+                result = handle.wait(remaining)
+                result.restarts = attempt
+                return result
+            except JobTimeout:
+                raise  # the job is slow, not broken — replaying won't help
+            except JobFailure:
+                attempt += 1
+                if attempt > restart_strategy.max_restarts:
+                    raise
+                if restart_strategy.delay_s:
+                    time.sleep(restart_strategy.delay_s)
+                # Resume from the newest completed checkpoint; before the
+                # first one lands, fall back to the CALLER'S restore point
+                # (or a clean replay when none was given).
+                from flink_tensorflow_tpu.checkpoint.store import latest_checkpoint_id
+
+                new_id = latest_checkpoint_id(self.checkpoint_dir)
+                if new_id is not None:
+                    restore, restore_id = self.checkpoint_dir, new_id
+                else:
+                    restore, restore_id = restore_from, restore_checkpoint_id
 
     def execute_async(
         self,
@@ -132,10 +198,11 @@ class StreamExecutionEnvironment:
         restore_checkpoint_id: typing.Optional[int] = None,
     ) -> JobHandle:
         executor = self._make_executor()
+        executor.checkpoint_interval_s = self.checkpoint_interval_s
         if restore_from is not None:
             from flink_tensorflow_tpu.checkpoint.store import read_checkpoint
 
-            _, snapshots = read_checkpoint(restore_from, restore_checkpoint_id)
-            executor.restore(snapshots)
+            cid, snapshots = read_checkpoint(restore_from, restore_checkpoint_id)
+            executor.restore(snapshots, from_checkpoint_id=cid)
         executor.start()
         return JobHandle(executor)

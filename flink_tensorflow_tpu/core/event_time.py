@@ -1,0 +1,158 @@
+"""Event time: timestamp assignment, watermarks, event-time windows.
+
+Flink's event-time machinery, rebuilt for this runtime (the reference
+inherits it wholesale from Flink — SURVEY.md §1 L1 "windows").  The
+pieces:
+
+- :class:`TimestampAssignerOperator` — stamps records with event time
+  from a user function and emits bounded-out-of-orderness watermarks
+  (``wm = max_ts - slack``).
+- :class:`EventTimeWindowOperator` — tumbling event-time windows per key:
+  buffers by (key, window), fires every window whose end <= the current
+  watermark, in window order; emits results stamped with the window end.
+
+The runtime's channel layer already merges watermarks per input channel
+(min across live channels, core/runtime.py) and the snapshot protocol
+covers open windows, so event-time jobs get exactly-once windows for
+free.
+"""
+
+from __future__ import annotations
+
+import math
+import typing
+
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.operators import Operator, _FunctionOperator
+from flink_tensorflow_tpu.core.windows import TimeWindow, WindowBuffer
+
+
+class TimestampAssignerOperator(Operator):
+    """Assigns event timestamps + periodic watermarks.
+
+    ``out_of_orderness_s`` is the lateness bound: the watermark trails
+    the max seen timestamp by that slack, so records up to that much out
+    of order still land in their window.
+    """
+
+    def __init__(self, name: str, ts_fn: typing.Callable[[typing.Any], float],
+                 out_of_orderness_s: float = 0.0, watermark_every: int = 32):
+        super().__init__(name)
+        self.ts_fn = ts_fn
+        self.slack = out_of_orderness_s
+        #: Emit a watermark every N records (Flink's periodic generator,
+        #: record-count-based): per-record watermarks double channel
+        #: traffic and make every downstream window sweep its buffers.
+        self.watermark_every = max(1, watermark_every)
+        self._max_ts = -math.inf
+        self._emitted_wm = -math.inf
+        self._since_wm = 0
+
+    def process_record(self, record: el.StreamRecord) -> None:
+        ts = float(self.ts_fn(record.value))
+        self.output.emit(record.value, ts)
+        self._max_ts = max(self._max_ts, ts)
+        self._since_wm += 1
+        if self._since_wm >= self.watermark_every:
+            self._since_wm = 0
+            wm = self._max_ts - self.slack
+            if wm > self._emitted_wm:
+                self._emitted_wm = wm
+                self.output.broadcast_element(el.Watermark(wm))
+
+    def process_watermark(self, watermark: el.Watermark) -> None:
+        pass  # upstream (processing-time) watermarks are superseded
+
+    def finish(self) -> None:
+        # Close the stream's event time so downstream windows all fire.
+        self.output.broadcast_element(el.Watermark(math.inf))
+
+    def _operator_snapshot(self):
+        return {"max_ts": self._max_ts, "emitted_wm": self._emitted_wm}
+
+    def _operator_restore(self, state):
+        self._max_ts = state["max_ts"]
+        self._emitted_wm = state["emitted_wm"]
+
+
+class EventTimeWindowOperator(_FunctionOperator):
+    """Tumbling event-time windows (keyed or global)."""
+
+    GLOBAL_KEY = "__subtask__"
+
+    def __init__(self, name: str, function: fn.WindowFunction, size_s: float,
+                 key_selector=None):
+        super().__init__(name, function)
+        if size_s <= 0:
+            raise ValueError(f"window size must be positive, got {size_s}")
+        self.size = float(size_s)
+        self.key_selector = key_selector
+        self._buffers: typing.Dict[typing.Tuple[typing.Any, float], WindowBuffer] = {}
+        self._watermark = -math.inf
+        self._collector: typing.Optional[fn.Collector] = None
+
+    def open(self) -> None:
+        self._collector = fn.Collector(self.output.emit)
+        super().open()
+
+    def process_record(self, record: el.StreamRecord) -> None:
+        if record.timestamp is None:
+            raise ValueError(
+                f"{self.name}: event-time window got a record without a "
+                "timestamp — add .assign_timestamps(...) upstream"
+            )
+        ts = record.timestamp
+        start = math.floor(ts / self.size) * self.size
+        if start + self.size <= self._watermark:
+            return  # its window already fired: late, dropped (Flink rule)
+        key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
+        buf = self._buffers.get((key, start))
+        if buf is None:
+            buf = WindowBuffer(window=TimeWindow(start, start + self.size))
+            self._buffers[(key, start)] = buf
+        buf.add(record.value, ts)
+
+    def process_watermark(self, watermark: el.Watermark) -> None:
+        self._watermark = max(self._watermark, watermark.timestamp)
+        due = sorted(
+            (k for k, buf in self._buffers.items() if buf.window.end <= self._watermark),
+            key=lambda k: (k[1], str(k[0])),
+        )
+        for k in due:
+            self._fire(k)
+        self.output.broadcast_element(watermark)
+
+    def _fire(self, k) -> None:
+        buf = self._buffers.pop(k)
+        key = k[0]
+        if self.key_selector is not None:
+            self.keyed_state.current_key = key
+        # Results are stamped with the window end (Flink's maxTimestamp
+        # convention) unless the function sets an explicit timestamp.
+        end = buf.window.end
+        collector = fn.Collector(
+            lambda v, ts=None: self.output.emit(v, end if ts is None else ts)
+        )
+        self.function.process_window(
+            key if self.key_selector is not None else None,
+            buf.window,
+            buf.elements,
+            collector,
+        )
+
+    def finish(self) -> None:
+        for k in sorted(self._buffers.keys(), key=lambda k: (k[1], str(k[0]))):
+            self._fire(k)
+        self.function.on_finish(self._collector)
+
+    def _operator_snapshot(self):
+        from flink_tensorflow_tpu.core.windows import snapshot_buffers
+
+        return {"watermark": self._watermark, "buffers": snapshot_buffers(self._buffers)}
+
+    def _operator_restore(self, state):
+        from flink_tensorflow_tpu.core.windows import restore_buffers
+
+        self._watermark = state["watermark"]
+        self._buffers = restore_buffers(state["buffers"])

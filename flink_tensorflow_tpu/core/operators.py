@@ -289,22 +289,14 @@ class WindowOperator(_FunctionOperator):
         self.function.on_finish(self._collector)
 
     def _operator_snapshot(self):
-        return {
-            "buffers": {
-                key: (buf.window, list(buf.elements), list(buf.timestamps))
-                for key, buf in self._buffers.items()
-            },
-            "seq": dict(self._window_seq),
-        }
+        from flink_tensorflow_tpu.core.windows import snapshot_buffers
+
+        return {"buffers": snapshot_buffers(self._buffers), "seq": dict(self._window_seq)}
 
     def _operator_restore(self, state):
-        self._buffers = {}
-        for key, (window, elements, timestamps) in state["buffers"].items():
-            buf = WindowBuffer(window=window)
-            buf.elements = list(elements)
-            buf.timestamps = list(timestamps)
-            buf.first_element_time = time.monotonic()
-            self._buffers[key] = buf
+        from flink_tensorflow_tpu.core.windows import restore_buffers
+
+        self._buffers = restore_buffers(state["buffers"])
         self._window_seq = dict(state["seq"])
 
 

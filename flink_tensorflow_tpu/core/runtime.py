@@ -38,6 +38,11 @@ class JobFailure(RuntimeError):
     pass
 
 
+class JobTimeout(JobFailure):
+    """join() deadline expired — NOT an operator failure; restart
+    strategies must propagate it instead of replaying a healthy job."""
+
+
 class _Subtask:
     def __init__(
         self,
@@ -203,6 +208,10 @@ class LocalExecutor:
         self.subtasks: typing.List[_Subtask] = []
         self._gates: typing.List[InputGate] = []
         self.coordinator = CheckpointCoordinator(self, checkpoint_dir)
+        self.checkpoint_interval_s: typing.Optional[float] = None
+        self._finished_count = 0
+        self._all_done = threading.Event()
+        self._periodic_thread: typing.Optional[threading.Thread] = None
         self._build()
 
     # --- plan construction ----------------------------------------------
@@ -289,7 +298,14 @@ class LocalExecutor:
                 self.subtasks.append(st)
 
     # --- restore ---------------------------------------------------------
-    def restore(self, snapshots: typing.Dict[str, typing.Dict[int, typing.Any]]) -> None:
+    def restore(
+        self,
+        snapshots: typing.Dict[str, typing.Dict[int, typing.Any]],
+        from_checkpoint_id: typing.Optional[int] = None,
+    ) -> None:
+        if from_checkpoint_id is not None:
+            # New checkpoints must never overwrite the restore point.
+            self.coordinator.resume_from(from_checkpoint_id)
         for st in self.subtasks:
             task_snaps = snapshots.get(st.t.name)
             if task_snaps is None:
@@ -305,6 +321,25 @@ class LocalExecutor:
             st.thread = threading.Thread(target=body, name=st.scope, daemon=True)
         for st in self.subtasks:
             st.thread.start()
+        if self.checkpoint_interval_s is not None:
+            self._periodic_thread = threading.Thread(
+                target=self._periodic_checkpoints, name="checkpoint-timer", daemon=True
+            )
+            self._periodic_thread.start()
+
+    def _periodic_checkpoints(self) -> None:
+        """Flink-style periodic snapshots (SURVEY.md §5 "Checkpoint /
+        resume"): trigger an aligned checkpoint every interval until the
+        job finishes.  Races with completion/cancellation are benign —
+        a trigger landing there just fails and is not retried."""
+        interval = self.checkpoint_interval_s
+        while not self._all_done.wait(interval) and not self.cancelled.is_set():
+            try:
+                self.coordinator.trigger(timeout=max(60.0, interval * 10))
+            except (TimeoutError, RuntimeError):
+                if self._all_done.is_set() or self.cancelled.is_set():
+                    return
+                logger.warning("periodic checkpoint failed", exc_info=True)
 
     def join(self, timeout: typing.Optional[float] = None) -> None:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -313,7 +348,7 @@ class LocalExecutor:
             st.thread.join(remaining)
             if st.thread.is_alive():
                 self.cancel()
-                raise JobFailure(f"timeout waiting for subtask {st.scope}")
+                raise JobTimeout(f"timeout waiting for subtask {st.scope}")
         if self._error is not None:
             raise JobFailure(f"job failed: {self._error!r}") from self._error
 
@@ -337,6 +372,10 @@ class LocalExecutor:
 
     def subtask_finished(self, subtask: _Subtask) -> None:
         self.coordinator.subtask_finished(subtask)
+        with self._error_lock:
+            self._finished_count += 1
+            if self._finished_count >= len(self.subtasks):
+                self._all_done.set()
 
     @property
     def total_subtasks(self) -> int:

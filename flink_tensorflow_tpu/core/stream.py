@@ -146,6 +146,29 @@ class DataStream:
     def union(self, *others: "DataStream") -> "UnionStream":
         return UnionStream(self.env, [self, *others])
 
+    # -- event time --------------------------------------------------------
+    def assign_timestamps(
+        self, ts_fn: typing.Callable[[typing.Any], float], *,
+        out_of_orderness_s: float = 0.0, watermark_every: int = 32,
+        name="timestamps",
+    ) -> "DataStream":
+        """Stamp records with event time and generate watermarks
+        (bounded out-of-orderness, emitted every ``watermark_every``
+        records).  Required upstream of time windows."""
+        from flink_tensorflow_tpu.core.event_time import TimestampAssignerOperator
+
+        t = self._add_op(
+            name,
+            lambda: TimestampAssignerOperator(name, ts_fn, out_of_orderness_s,
+                                              watermark_every),
+            self.transformation.parallelism,
+        )
+        return DataStream(self.env, t)
+
+    def time_window_all(self, size_s: float) -> "EventTimeWindowedStream":
+        """Tumbling event-time window over the whole (per-subtask) stream."""
+        return EventTimeWindowedStream(self.env, self, size_s, key_selector=None)
+
     # -- windows ----------------------------------------------------------
     def count_window(
         self, size: int, *, timeout_s: typing.Optional[float] = None
@@ -216,6 +239,76 @@ class KeyedStream:
             CountTrigger(size) if timeout_s is None else CountOrTimeoutTrigger(size, timeout_s)
         )
         return WindowedStream(self.env, self, trigger, key_selector=self.key_selector)
+
+    def time_window(self, size_s: float) -> "EventTimeWindowedStream":
+        """Tumbling event-time window per key (records must carry
+        timestamps — see DataStream.assign_timestamps)."""
+        return EventTimeWindowedStream(self.env, self, size_s, key_selector=self.key_selector)
+
+    def reduce(self, f: typing.Union["fn.ReduceFunction", typing.Callable], *,
+               name="reduce", parallelism=None) -> DataStream:
+        """Running per-key reduction; emits the updated accumulator per
+        record (Flink KeyedStream.reduce semantics)."""
+        reducer = f if isinstance(f, fn.ReduceFunction) else _LambdaReduce(f)
+        return self.process(_ReduceProcess(reducer), name=name, parallelism=parallelism)
+
+
+class _LambdaReduce(fn.ReduceFunction):
+    def __init__(self, f):
+        self.f = f
+
+    def reduce(self, acc, value):
+        return self.f(acc, value)
+
+
+class _ReduceProcess(fn.ProcessFunction):
+    """Keyed running reduce on top of ProcessFunction + ValueState."""
+
+    def __init__(self, reducer: fn.ReduceFunction):
+        self.reducer = reducer
+
+    def open(self, ctx):
+        from flink_tensorflow_tpu.core.state import StateDescriptor
+
+        self.reducer.open(ctx)
+        self._desc = StateDescriptor("reduce_acc")
+
+    def close(self):
+        self.reducer.close()
+
+    def process_element(self, value, ctx, out: fn.Collector):
+        state = ctx.state(self._desc)
+        acc = state.value()
+        acc = value if acc is None else self.reducer.reduce(acc, value)
+        state.update(acc)
+        out.collect(acc)
+
+
+class EventTimeWindowedStream:
+    """Tumbling event-time windows; fire on watermark passage."""
+
+    def __init__(self, env, upstream, size_s: float, key_selector):
+        self.env = env
+        self.upstream = upstream  # DataStream or KeyedStream
+        self.size_s = size_s
+        self.key_selector = key_selector
+
+    def apply(self, f: fn.WindowFunction, *, name="time_window", parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.event_time import EventTimeWindowOperator
+
+        parallelism = parallelism or self.env.default_parallelism
+        if isinstance(self.upstream, KeyedStream):
+            edge = self.upstream._edge()
+        else:
+            edge = self.upstream._edge(parallelism)
+        t = self.env.graph.add(
+            name,
+            lambda: EventTimeWindowOperator(name, f, self.size_s,
+                                            key_selector=self.key_selector),
+            parallelism,
+            inputs=[edge],
+        )
+        return DataStream(self.env, t)
 
 
 class WindowedStream:

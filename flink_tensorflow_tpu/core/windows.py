@@ -97,3 +97,25 @@ class WindowBuffer:
             self.first_element_time = time.monotonic()
         self.elements.append(value)
         self.timestamps.append(timestamp)
+
+
+def snapshot_buffers(buffers: typing.Mapping[typing.Any, WindowBuffer]) -> dict:
+    """Picklable snapshot of open windows (shared by the count/timeout and
+    event-time window operators — one format, one restore path)."""
+    return {
+        key: (buf.window, list(buf.elements), list(buf.timestamps))
+        for key, buf in buffers.items()
+    }
+
+
+def restore_buffers(snap: dict) -> typing.Dict[typing.Any, WindowBuffer]:
+    out: typing.Dict[typing.Any, WindowBuffer] = {}
+    for key, (window, elements, timestamps) in snap.items():
+        buf = WindowBuffer(window=window)
+        buf.elements = list(elements)
+        buf.timestamps = list(timestamps)
+        # Restart resets the processing-time clock: timeout triggers count
+        # from the restore, not the (meaningless) pre-crash wall time.
+        buf.first_element_time = time.monotonic()
+        out[key] = buf
+    return out

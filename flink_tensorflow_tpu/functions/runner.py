@@ -27,6 +27,7 @@ from flink_tensorflow_tpu.tensors.batching import Batch, BucketPolicy, assemble
 from flink_tensorflow_tpu.tensors.coercion import coerce
 from flink_tensorflow_tpu.tensors.transfer import DeviceTransfer
 from flink_tensorflow_tpu.tensors.value import TensorValue
+from flink_tensorflow_tpu.utils.profiling import annotate_batch
 
 if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
@@ -61,6 +62,7 @@ class CompiledMethodRunner:
         self._metrics = None
         #: In-flight dispatched batches: (batch, output futures, t0).
         self._pending: collections.deque = collections.deque()
+        self._batch_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, ctx: typing.Optional["RuntimeContext"] = None) -> None:
@@ -132,13 +134,15 @@ class CompiledMethodRunner:
             r if isinstance(r, TensorValue) else coerce(r, self.method.input_schema)
             for r in records
         ]
-        batch = assemble(tvs, self.method.input_schema, self.policy)
-        inputs = self._transfer.to_device(batch)
-        if self.method.needs_lengths:
-            lengths = self._transfer.lengths_to_device(batch)
-            outputs = self._jit_fn(self._params_on_device, inputs, lengths)
-        else:
-            outputs = self._jit_fn(self._params_on_device, inputs)
+        self._batch_seq += 1
+        with annotate_batch(f"{self.model.name}.{self.method.name}", self._batch_seq):
+            batch = assemble(tvs, self.method.input_schema, self.policy)
+            inputs = self._transfer.to_device(batch)
+            if self.method.needs_lengths:
+                lengths = self._transfer.lengths_to_device(batch)
+                outputs = self._jit_fn(self._params_on_device, inputs, lengths)
+            else:
+                outputs = self._jit_fn(self._params_on_device, inputs)
         self._pending.append((batch, outputs, t0))
 
     def _fetch_oldest(self) -> typing.List[TensorValue]:

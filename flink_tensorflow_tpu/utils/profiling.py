@@ -1,0 +1,54 @@
+"""Tracing / profiling — the observability the reference leaves to Flink.
+
+The reference has no library-specific tracing (SURVEY.md §5 "Tracing /
+profiling": Flink's latency markers + unused TF RunOptions).  The TPU
+build gets first-class hooks because the north-star metric IS a latency
+number (BASELINE.json:2):
+
+- :func:`trace` — context manager around a job run; writes an XLA/TPU
+  profiler trace (TensorBoard-loadable) covering device compute, HBM
+  transfers, and host Python.
+- :func:`annotate_batch` — names one micro-batch execution so trace
+  timelines attribute device work to operator + batch number.
+- per-operator latency histograms/meters live in metrics.registry and
+  are always on (p50/p99 per record — the north-star denominators).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import typing
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, host_tracer_level: int = 2):
+    """Capture a jax profiler trace for the enclosed block.
+
+    View with TensorBoard (``tensorboard --logdir <log_dir>``) or
+    xprof; includes XLA device timelines + host annotations.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_batch(scope: str, step: int):
+    """Step annotation for one dispatched batch: shows up as a named
+    region on the trace timeline (`scope` = operator subtask)."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(scope, step_num=step)
+
+
+def device_memory_stats(device=None) -> typing.Dict[str, int]:
+    """Live HBM usage for capacity debugging (bytes_in_use etc.);
+    empty dict on backends without memory_stats."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}

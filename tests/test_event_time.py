@@ -1,0 +1,97 @@
+"""Event-time windows, watermarks, keyed reduce — the Flink streaming
+semantics the reference jobs build on (SURVEY.md §1 L1)."""
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+
+
+class CollectWindow(fn.WindowFunction):
+    def process_window(self, key, window, elements, out):
+        out.collect((key, window.start, sorted(elements, key=str)))
+
+
+class TestEventTimeWindows:
+    def test_keyed_tumbling_windows(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # (key, event_time): out-of-order within 1s slack
+        events = [("a", 0.5), ("b", 0.7), ("b", 0.2), ("a", 1.2),
+                  ("a", 0.9), ("b", 2.1), ("a", 2.6)]
+        out = (
+            env.from_collection(events)
+            .assign_timestamps(lambda e: e[1], out_of_orderness_s=1.0)
+            .key_by(lambda e: e[0])
+            .time_window(1.0)
+            .apply(CollectWindow())
+            .sink_to_list()
+        )
+        env.execute(timeout=60)
+        got = {(key, start): [t for _, t in elems] for key, start, elems in out}
+        assert got == {
+            ("a", 0.0): [0.5, 0.9],
+            ("a", 1.0): [1.2],
+            ("a", 2.0): [2.6],
+            ("b", 0.0): [0.2, 0.7],
+            ("b", 2.0): [2.1],
+        }
+
+    def test_late_records_beyond_slack_dropped(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        events = [("a", 0.1), ("a", 5.0), ("a", 0.2)]  # 0.2 arrives after wm=5-0=5
+        out = (
+            env.from_collection(events)
+            .assign_timestamps(lambda e: e[1], out_of_orderness_s=0.0,
+                               watermark_every=1)
+            .key_by(lambda e: e[0])
+            .time_window(1.0)
+            .apply(CollectWindow())
+            .sink_to_list()
+        )
+        env.execute(timeout=60)
+        all_ts = [t for _, _, elems in out for _, t in elems]
+        assert 0.2 not in all_ts and 0.1 in all_ts and 5.0 in all_ts
+
+    def test_global_time_window(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_collection([(i, float(i)) for i in range(10)])
+            .assign_timestamps(lambda e: e[1])
+            .time_window_all(4.0)
+            .apply(CollectWindow())
+            .sink_to_list()
+        )
+        env.execute(timeout=60)
+        sizes = sorted(len(elems) for _, _, elems in out)
+        assert sizes == [2, 4, 4]  # [0..3], [4..7], [8..9]
+
+    def test_missing_timestamps_fail_loud(self):
+        from flink_tensorflow_tpu.core.runtime import JobFailure
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_collection([1, 2, 3])
+            .key_by(lambda x: x)
+            .time_window(1.0)
+            .apply(CollectWindow())
+            .sink_to_list()
+        )
+        with pytest.raises(JobFailure):
+            env.execute(timeout=60)
+
+
+class TestKeyedReduce:
+    def test_running_reduce(self):
+        env = StreamExecutionEnvironment(parallelism=2)
+        out = (
+            env.from_collection([("a", 1), ("b", 10), ("a", 2), ("b", 20), ("a", 3)])
+            .key_by(lambda e: e[0])
+            .reduce(lambda acc, v: (acc[0], acc[1] + v[1]))
+            .sink_to_list()
+        )
+        env.execute(timeout=60)
+        finals = {}
+        for key, total in out:
+            finals[key] = max(finals.get(key, 0), total)
+        assert finals == {"a": 6, "b": 30}

@@ -1,0 +1,126 @@
+"""Failure recovery: periodic checkpoints + restart strategy — the
+reference's Flink-inherited failover semantics (SURVEY.md §5: heartbeats,
+restart strategies, region failover -> here: supervisor restart from the
+latest aligned snapshot)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.environment import RestartStrategy
+from flink_tensorflow_tpu.core.runtime import JobFailure
+from flink_tensorflow_tpu.core.state import StateDescriptor
+
+
+class FailOnce(fn.ProcessFunction):
+    """Counts records per key; crashes once at a chosen record count.
+
+    The crash flag is shared across clones/restarts via a mutable box so
+    only the FIRST attempt fails (the restart must succeed).
+    """
+
+    def __init__(self, fail_at: int, crashed_box: list):
+        self.fail_at = fail_at
+        self.crashed = crashed_box
+        self._seen = 0
+
+    def clone(self):
+        return FailOnce(self.fail_at, self.crashed)
+
+    def process_element(self, value, ctx, out):
+        self._seen += 1
+        if not self.crashed[0] and self._seen >= self.fail_at:
+            self.crashed[0] = True
+            raise RuntimeError("injected failure")
+        count = ctx.state(StateDescriptor("count", lambda: 0))
+        count.update((count.value() or 0) + 1)
+        out.collect((ctx.current_key, count.value(), value))
+
+    def snapshot_state(self):
+        return {"seen": self._seen}
+
+    def restore_state(self, state):
+        self._seen = state["seen"]
+
+
+class TestRestartStrategy:
+    def test_restart_resumes_from_checkpoint(self, tmp_path):
+        """Inject one failure mid-stream: with periodic checkpoints + a
+        restart strategy the job completes and keyed counts are
+        exactly-once (every record counted exactly once in state)."""
+        n = 200
+        crashed = [False]
+
+        def build(env):
+            out = (
+                env.from_collection(list(range(n)))
+                .key_by(lambda x: x % 4)
+                .process(FailOnce(fail_at=50, crashed_box=crashed), name="count")
+                .sink_to_list()
+            )
+            return out
+
+        env = StreamExecutionEnvironment(parallelism=2)
+        env.enable_checkpointing(str(tmp_path / "chk"), interval_s=0.05)
+        env.source_throttle_s = 0.002  # stretch the job so checkpoints land
+        out = build(env)
+        result = env.execute(timeout=120, restart_strategy=RestartStrategy(max_restarts=2))
+        assert result.restarts == 1
+        assert crashed[0]
+        # State exactly-once: the highest count per key == records of that key.
+        final = {}
+        for key, count, value in out:
+            final[key] = max(final.get(key, 0), count)
+        assert final == {k: n // 4 for k in range(4)}
+        # Every record was processed at least once (sink is at-least-once).
+        values = {v for _, _, v in out}
+        assert values == set(range(n))
+
+    def test_restarts_exhausted_raises(self, tmp_path):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk"))
+
+        class AlwaysFail(fn.MapFunction):
+            def map(self, value):
+                raise RuntimeError("boom")
+
+        env.from_collection([1, 2, 3]).map(AlwaysFail()).sink_to_list()
+        with pytest.raises(JobFailure):
+            env.execute(timeout=60, restart_strategy=RestartStrategy(max_restarts=1))
+
+    def test_timeout_is_not_retried(self, tmp_path):
+        """A slow-but-healthy job hitting the execute timeout must raise
+        JobTimeout immediately, not burn restart attempts replaying."""
+        from flink_tensorflow_tpu.core.runtime import JobTimeout
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk"))
+        env.source_throttle_s = 0.05
+        env.from_collection(list(range(1000))).map(lambda x: x).sink_to_list()
+        import time
+
+        t0 = time.monotonic()
+        with pytest.raises(JobTimeout):
+            env.execute(timeout=0.5, restart_strategy=RestartStrategy(max_restarts=5))
+        assert time.monotonic() - t0 < 5.0  # no retry cycles happened
+
+    def test_restart_requires_checkpointing(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.from_collection([1]).sink_to_list()
+        with pytest.raises(ValueError):
+            env.execute(restart_strategy=RestartStrategy())
+
+
+class TestPeriodicCheckpoints:
+    def test_periodic_snapshots_written(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import latest_checkpoint_id
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk"), interval_s=0.05)
+        env.source_throttle_s = 0.005
+        env.from_collection(list(range(100))).map(lambda x: x).sink_to_list()
+        env.execute(timeout=60)
+        assert latest_checkpoint_id(str(tmp_path / "chk")) is not None
