@@ -1,0 +1,127 @@
+"""Remote record plane — cross-process/host stream channels over TCP.
+
+The reference's record plane is Flink's Netty shuffle between
+TaskManagers (SURVEY.md §2 "Distributed communication backend").  In the
+TPU framework, *gradients* never touch this layer (they ride XLA
+collectives over ICI/DCN inside the compiled step); the host-side record
+plane only carries stream records between processes/hosts — job-to-job
+pipes, ingestion from feeders, multi-host source fan-in.
+
+``RemoteSink`` streams length-prefixed codec frames (tensors/serde.py)
+to a peer; ``RemoteSource`` accepts one connection and yields records.
+Delivery is at-least-once only if the upstream replays on failure — TCP
+sources are non-replayable, so exactly-once jobs should front them with
+a durable log, exactly as Flink treats raw socket sources.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import typing
+
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.tensors.serde import decode_record, encode_record
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+_LEN = struct.Struct("<Q")
+
+
+class RemoteSink(fn.SinkFunction):
+    """Ships records (TensorValue) to a RemoteSource over TCP."""
+
+    def __init__(self, host: str, port: int, *, connect_timeout_s: float = 30.0):
+        self.host = host
+        self.port = port
+        self.connect_timeout_s = connect_timeout_s
+        self._sock: typing.Optional[socket.socket] = None
+
+    def clone(self):
+        return RemoteSink(self.host, self.port, connect_timeout_s=self.connect_timeout_s)
+
+    def open(self, ctx) -> None:
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def invoke(self, value) -> None:
+        if not isinstance(value, TensorValue):
+            raise TypeError("RemoteSink carries TensorValue records")
+        payload = encode_record(value)
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            self._sock.close()
+            self._sock = None
+
+
+class RemoteSource(fn.SourceFunction):
+    """Accepts ONE RemoteSink connection and yields its records.
+
+    Bind with port=0 to pick a free port; read it from :attr:`port`
+    after construction (the listener opens eagerly so the peer can
+    connect before the job starts).
+    """
+
+    def __init__(self, bind: str = "0.0.0.0", port: int = 0,
+                 *, accept_timeout_s: float = 60.0):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind, port))
+        self._listener.listen(1)
+        self.port = self._listener.getsockname()[1]
+        self.accept_timeout_s = accept_timeout_s
+
+    def clone(self):
+        return self  # the listener is the identity; parallelism must be 1
+
+    def open(self, ctx) -> None:
+        if ctx.parallelism != 1:
+            raise RuntimeError(
+                "RemoteSource accepts exactly one connection — run it with "
+                f"parallelism=1 (got {ctx.parallelism})"
+            )
+
+    def run(self) -> typing.Iterator[typing.Any]:
+        self._listener.settimeout(self.accept_timeout_s)
+        conn, _ = self._listener.accept()
+        conn.settimeout(None)
+        try:
+            buf = b""
+
+            def read_exact(n: int, *, mid_frame: bool) -> typing.Optional[bytes]:
+                nonlocal buf
+                while len(buf) < n:
+                    chunk = conn.recv(1 << 20)
+                    if not chunk:
+                        if buf or mid_frame:
+                            # EOF inside a frame = peer died mid-send; a
+                            # silent stop would pass truncation off as a
+                            # clean close.
+                            raise ConnectionError(
+                                "remote peer closed mid-frame (stream truncated)"
+                            )
+                        return None
+                    buf += chunk
+                out, buf = buf[:n], buf[n:]
+                return out
+
+            while True:
+                head = read_exact(_LEN.size, mid_frame=False)
+                if head is None:
+                    return  # clean shutdown between frames
+                (length,) = _LEN.unpack(head)
+                payload = read_exact(length, mid_frame=True)
+                yield decode_record(payload)
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._listener.close()

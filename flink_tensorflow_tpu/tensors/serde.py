@@ -1,0 +1,69 @@
+"""Binary record codec — the TypeInformation/serializer counterpart.
+
+The reference registers tensors with Flink's serializer stack so records
+survive network shuffles and checkpoints (SURVEY.md §2 "Tensor
+TypeInformation/serializer").  In-process hops here pass records by
+reference (no serialization at all — threads share the arena/heap); this
+codec exists for the boundaries where bytes are unavoidable: the remote
+record plane between hosts (io/remote.py) and compact persisted streams.
+
+Wire format (little-endian):
+  u32 magic 'FTTR' | u32 header_len | u32 meta_len | header (json)
+  | meta (pickle) | field buffers
+header = {"fields": [[name, shape, dtype], ...]}
+Meta is pickled (it is "arbitrary picklable metadata" per TensorValue's
+contract — numpy scalars, tuples, non-str keys all round-trip; the
+record plane is an intra-cluster trust boundary, same stance as Flink's
+Kryo).  Buffers follow in header order, tightly packed — decode is
+zero-copy (``np.frombuffer`` views over the received bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+import typing
+
+import numpy as np
+
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+MAGIC = 0x52545446  # 'FTTR'
+_HEADER = struct.Struct("<III")
+
+
+def encode_record(record: TensorValue) -> bytes:
+    fields = []
+    buffers = []
+    for name, arr in record.fields.items():
+        a = np.asarray(arr)
+        # NB: ascontiguousarray would promote 0-d to 1-d; keep the true
+        # shape and let tobytes() handle contiguity.
+        fields.append([name, list(a.shape), a.dtype.str])
+        buffers.append(a.tobytes())
+    header = json.dumps({"fields": fields}).encode()
+    meta = pickle.dumps(dict(record.meta), protocol=pickle.HIGHEST_PROTOCOL)
+    return b"".join(
+        [_HEADER.pack(MAGIC, len(header), len(meta)), header, meta, *buffers]
+    )
+
+
+def decode_record(data: typing.Union[bytes, memoryview]) -> TensorValue:
+    view = memoryview(data)
+    magic, header_len, meta_len = _HEADER.unpack_from(view, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad record magic {magic:#x}")
+    off = _HEADER.size
+    header = json.loads(bytes(view[off:off + header_len]))
+    off += header_len
+    meta = pickle.loads(view[off:off + meta_len])
+    off += meta_len
+    out = {}
+    for name, shape, dtype_str in header["fields"]:
+        dtype = np.dtype(dtype_str)
+        count = int(np.prod(shape)) if shape else 1  # prod(()) is 1 anyway
+        arr = np.frombuffer(view, dtype=dtype, count=count, offset=off).reshape(shape)
+        out[name] = arr
+        off += count * dtype.itemsize
+    return TensorValue(out, meta)

@@ -1,0 +1,78 @@
+"""Record codec + remote record plane: cross-process stream channels
+(the Netty-shuffle counterpart, SURVEY.md §2 distributed backend)."""
+
+import threading
+
+import numpy as np
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+from flink_tensorflow_tpu.tensors import TensorValue
+from flink_tensorflow_tpu.tensors.serde import decode_record, encode_record
+
+
+class TestSerde:
+    def test_roundtrip(self):
+        rec = TensorValue(
+            {"image": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "label": np.int32(7)},
+            {"id": 42, "tag": "x"},
+        )
+        out = decode_record(encode_record(rec))
+        assert out == rec and out.meta == {"id": 42, "tag": "x"}
+
+    def test_decode_is_zero_copy(self):
+        rec = TensorValue({"x": np.arange(1000, dtype=np.float32)})
+        data = encode_record(rec)
+        out = decode_record(data)
+        assert out["x"].base is not None  # view over the wire buffer
+
+    def test_bad_magic(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            decode_record(b"\x00" * 16)
+
+    def test_zero_size_field(self):
+        rec = TensorValue({"x": np.zeros((0, 3), np.float32),
+                           "y": np.ones((2,), np.float32)})
+        out = decode_record(encode_record(rec))
+        assert out == rec and out["x"].shape == (0, 3)
+
+    def test_numpy_meta_roundtrip(self):
+        rec = TensorValue({"x": np.zeros(2, np.float32)},
+                          {"id": np.int64(7), "pair": (1, 2)})
+        out = decode_record(encode_record(rec))
+        assert out.meta["id"] == 7 and out.meta["pair"] == (1, 2)
+
+
+class TestRemoteChannel:
+    def test_job_to_job_pipe(self):
+        """Two jobs in separate 'processes' (threads here): upstream maps
+        and ships records over TCP; downstream consumes and sinks."""
+        source = RemoteSource(bind="127.0.0.1")
+
+        def upstream():
+            env = StreamExecutionEnvironment(parallelism=1)
+            records = [
+                TensorValue({"x": np.full(4, i, np.float32)}, {"i": i})
+                for i in range(50)
+            ]
+            (
+                env.from_collection(records)
+                .map(lambda r: r.replace(x=r["x"] * 2))
+                .add_sink(RemoteSink("127.0.0.1", source.port))
+            )
+            env.execute(timeout=60)
+
+        t = threading.Thread(target=upstream)
+        t.start()
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        out = env2.from_source(source).sink_to_list()
+        env2.execute(timeout=60)
+        t.join()
+
+        assert len(out) == 50
+        got = {r.meta["i"]: float(r["x"][0]) for r in out}
+        assert got == {i: 2.0 * i for i in range(50)}
