@@ -16,11 +16,13 @@ def force_cpu(virtual_devices: int = 8) -> None:
     """Pin jax to the CPU backend with N virtual devices.  Safe to call
     before OR after jax import, but before any backend-touching call."""
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={virtual_devices}"
-        ).strip()
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={virtual_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
