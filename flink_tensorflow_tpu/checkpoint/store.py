@@ -17,19 +17,61 @@ import shutil
 import typing
 
 
+class _PRNGKeyData:
+    """Picklable stand-in for a typed PRNG key (extended dtypes cannot be
+    np.asarray'd).  Stores the raw counter words + impl name; rebuilt with
+    ``jax.random.wrap_key_data`` on read."""
+
+    __slots__ = ("impl", "data")
+
+    def __init__(self, impl: str, data) -> None:
+        self.impl = impl
+        self.data = data
+
+    def __eq__(self, other) -> bool:
+        import numpy as np
+
+        return (
+            isinstance(other, _PRNGKeyData)
+            and self.impl == other.impl
+            and np.array_equal(self.data, other.data)
+        )
+
+
 def _to_host(obj: typing.Any) -> typing.Any:
-    """Recursively convert jax arrays to numpy so snapshots pickle portably."""
+    """Convert jax arrays to numpy so snapshots pickle portably.
+
+    Uses ``jax.tree.map`` so pytree *structure* — critically namedtuples
+    like optax's ScaleByAdamState — survives the round trip intact; typed
+    PRNG keys become :class:`_PRNGKeyData` markers."""
     import jax
     import numpy as np
 
-    if isinstance(obj, jax.Array):
-        return np.asarray(obj)
-    if isinstance(obj, dict):
-        return {k: _to_host(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        converted = [_to_host(v) for v in obj]
-        return type(obj)(converted) if not isinstance(obj, tuple) else tuple(converted)
-    return obj
+    def conv(leaf):
+        if isinstance(leaf, jax.Array):
+            if jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+                return _PRNGKeyData(
+                    str(jax.random.key_impl(leaf)),
+                    np.asarray(jax.random.key_data(leaf)),
+                )
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree.map(conv, obj)
+
+
+def _rebuild_keys(obj: typing.Any) -> typing.Any:
+    """Inverse of the PRNG-key marker in :func:`_to_host`."""
+    import jax
+
+    def conv(leaf):
+        if isinstance(leaf, _PRNGKeyData):
+            return jax.random.wrap_key_data(
+                jax.numpy.asarray(leaf.data), impl=leaf.impl
+            )
+        return leaf
+
+    return jax.tree.map(conv, obj, is_leaf=lambda x: isinstance(x, _PRNGKeyData))
 
 
 def _chk_dir(base: str, checkpoint_id: int) -> str:
@@ -82,4 +124,4 @@ def read_checkpoint(
         if checkpoint_id is None:
             raise FileNotFoundError(f"no checkpoints under {base_dir}")
     with open(os.path.join(_chk_dir(base_dir, checkpoint_id), "state.pkl"), "rb") as f:
-        return checkpoint_id, pickle.load(f)
+        return checkpoint_id, _rebuild_keys(pickle.load(f))

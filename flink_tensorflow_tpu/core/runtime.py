@@ -336,7 +336,11 @@ class LocalExecutor:
         while not self._all_done.wait(interval) and not self.cancelled.is_set():
             try:
                 self.coordinator.trigger(timeout=max(60.0, interval * 10))
-            except (TimeoutError, RuntimeError):
+            except Exception:
+                # Catch EVERYTHING: an escaping error (serialization bug,
+                # disk full, ...) would otherwise kill this daemon thread
+                # silently and the job would run on unpersisted, believing
+                # it is being checkpointed.
                 if self._all_done.is_set() or self.cancelled.is_set():
                     return
                 logger.warning("periodic checkpoint failed", exc_info=True)
