@@ -41,37 +41,45 @@ class _PRNGKeyData:
 def _to_host(obj: typing.Any) -> typing.Any:
     """Convert jax arrays to numpy so snapshots pickle portably.
 
-    Uses ``jax.tree.map`` so pytree *structure* — critically namedtuples
-    like optax's ScaleByAdamState — survives the round trip intact; typed
-    PRNG keys become :class:`_PRNGKeyData` markers."""
+    Manual recursion rather than ``jax.tree.map``: tree flattening sorts
+    dict keys, which raises on the mixed-type keys keyed state legally
+    contains (int and str user keys in one table).  Namedtuples — optax's
+    ScaleByAdamState et al. — are rebuilt as their own type, and typed
+    PRNG keys become picklable :class:`_PRNGKeyData` markers."""
     import jax
     import numpy as np
 
-    def conv(leaf):
-        if isinstance(leaf, jax.Array):
-            if jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key):
-                return _PRNGKeyData(
-                    str(jax.random.key_impl(leaf)),
-                    np.asarray(jax.random.key_data(leaf)),
-                )
-            return np.asarray(leaf)
-        return leaf
-
-    return jax.tree.map(conv, obj)
+    if isinstance(obj, jax.Array):
+        if jax.dtypes.issubdtype(obj.dtype, jax.dtypes.prng_key):
+            return _PRNGKeyData(
+                str(jax.random.key_impl(obj)),
+                np.asarray(jax.random.key_data(obj)),
+            )
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        converted = [_to_host(v) for v in obj]
+        if hasattr(obj, "_fields"):  # namedtuple: keep the type
+            return type(obj)(*converted)
+        return type(obj)(converted)
+    return obj
 
 
 def _rebuild_keys(obj: typing.Any) -> typing.Any:
     """Inverse of the PRNG-key marker in :func:`_to_host`."""
     import jax
 
-    def conv(leaf):
-        if isinstance(leaf, _PRNGKeyData):
-            return jax.random.wrap_key_data(
-                jax.numpy.asarray(leaf.data), impl=leaf.impl
-            )
-        return leaf
-
-    return jax.tree.map(conv, obj, is_leaf=lambda x: isinstance(x, _PRNGKeyData))
+    if isinstance(obj, _PRNGKeyData):
+        return jax.random.wrap_key_data(jax.numpy.asarray(obj.data), impl=obj.impl)
+    if isinstance(obj, dict):
+        return {k: _rebuild_keys(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        converted = [_rebuild_keys(v) for v in obj]
+        if hasattr(obj, "_fields"):
+            return type(obj)(*converted)
+        return type(obj)(converted)
+    return obj
 
 
 def _chk_dir(base: str, checkpoint_id: int) -> str:

@@ -61,6 +61,7 @@ class _ModelFunctionBase(fn.RichFunction):
         warmup_length_bucket: int = 128,
         donate_inputs: bool = False,
         outputs: typing.Optional[typing.Sequence[str]] = None,
+        transfer_lanes: int = 1,
     ):
         self._source = model
         self._method_name = method
@@ -69,6 +70,7 @@ class _ModelFunctionBase(fn.RichFunction):
         self._warmup_length_bucket = warmup_length_bucket
         self._donate = donate_inputs
         self._outputs = outputs
+        self._transfer_lanes = transfer_lanes
         self.runner: typing.Optional[CompiledMethodRunner] = None
         self._out: typing.Optional[fn.Collector] = None
 
@@ -91,6 +93,7 @@ class _ModelFunctionBase(fn.RichFunction):
             policy=self._policy,
             donate_inputs=self._donate,
             output_names=self._outputs,
+            dispatch_lanes=self._transfer_lanes,
         )
         self.runner.open(ctx)
         if self._warmup:
@@ -122,14 +125,21 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
     Dispatch is pipelined (``pipeline_depth`` batches in flight): while
     the device runs window k, the host batches and ships window k+1 —
     transfer hides under compute, which is the throughput lever on
-    PCIe/tunnel-attached chips.  In-flight batches are flushed at end of
-    input and before every state snapshot, so barriers never have results
-    in limbo (exactly-once, SURVEY.md §7 hard part 5).
+    PCIe/tunnel-attached chips.  ``transfer_lanes > 1`` additionally
+    overlaps the wire transfers of in-flight batches on a thread pool
+    (the lever when single-stream transfer bandwidth is the ceiling);
+    ``pipeline_depth`` defaults to ``2 * transfer_lanes`` so the lanes
+    stay fed.  In-flight batches are flushed at end of input and before
+    every state snapshot, so barriers never have results in limbo
+    (exactly-once, SURVEY.md §7 hard part 5).
     """
 
     def __init__(self, model: ModelSource, method: str = "serve", *,
-                 pipeline_depth: int = 2, idle_flush_s: float = 0.05, **kw):
+                 pipeline_depth: typing.Optional[int] = None,
+                 idle_flush_s: float = 0.05, **kw):
         super().__init__(model, method, **kw)
+        if pipeline_depth is None:
+            pipeline_depth = 2 * self._transfer_lanes
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self._max_in_flight = pipeline_depth - 1

@@ -14,11 +14,19 @@ hot loop).  The TPU-native engine room:
 - dispatch is async: the jitted call returns futures, and ``run_batch``
   only blocks when fetching results — back-to-back windows overlap host
   batching with device compute.
+- ``dispatch_lanes > 1`` runs assemble+transfer+launch on a small thread
+  pool.  On tunnel/network-attached devices the host->device wire
+  transfer is paid synchronously inside the dispatch call, so one lane
+  caps throughput at single-stream wire bandwidth; concurrent lanes
+  overlap the transfers of consecutive micro-batches (measured ~2x
+  aggregate bandwidth on the axon tunnel).  Results are collected in
+  dispatch order regardless of lane completion order.
 """
 
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import time
 import typing
 
@@ -45,12 +53,17 @@ class CompiledMethodRunner:
         device=None,
         donate_inputs: bool = False,
         output_names: typing.Optional[typing.Sequence[str]] = None,
+        dispatch_lanes: int = 1,
     ):
+        if dispatch_lanes < 1:
+            raise ValueError("dispatch_lanes must be >= 1")
         self.model = model
         self.method = model.method(method_name)
         self.policy = policy or BucketPolicy()
         self.device = device
         self.donate_inputs = donate_inputs
+        self.dispatch_lanes = dispatch_lanes
+        self._pool: typing.Optional[concurrent.futures.ThreadPoolExecutor] = None
         #: Subset of method outputs to return; selection happens INSIDE the
         #: jitted fn so XLA dead-code-eliminates unused heads and the
         #: device->host fetch only moves what the job consumes (fetch bytes
@@ -99,6 +112,11 @@ class CompiledMethodRunner:
         donate = (1,) if self.donate_inputs else ()
         # Pin execution to the subtask's device; params already live there.
         self._jit_fn = jax.jit(call, donate_argnums=donate)
+        if self.dispatch_lanes > 1 and self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.dispatch_lanes,
+                thread_name_prefix=f"{self.model.name}-dispatch",
+            )
         if ctx is not None:
             self._metrics = ctx.metrics
 
@@ -109,12 +127,22 @@ class CompiledMethodRunner:
 
         schema = self.method.input_schema
         shapes = schema.resolve_dynamic(length_bucket)
-        for b in batch_sizes:
-            fields = {n: np.zeros(shapes[n], schema[n].dtype) for n in schema.names}
-            self.run_batch([TensorValue(fields)] * b)
+        # Warmup batches pay the XLA compile inside the dispatch interval;
+        # keep them out of the steady-state metrics (dispatch_s would
+        # otherwise report compile time as wire-transfer time).
+        metrics, self._metrics = self._metrics, None
+        try:
+            for b in batch_sizes:
+                fields = {n: np.zeros(shapes[n], schema[n].dtype) for n in schema.names}
+                self.run_batch([TensorValue(fields)] * b)
+        finally:
+            self._metrics = metrics
 
     def close(self) -> None:
         self._pending.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool = None
         self._params_on_device = None
         self._jit_fn = None
 
@@ -124,36 +152,63 @@ class CompiledMethodRunner:
 
         jax dispatch is async: the jitted call returns future-backed
         arrays immediately, so the device crunches this batch while the
-        host assembles the next one.  Results are collected by
-        :meth:`collect_ready` / :meth:`flush`.
+        host assembles the next one.  With ``dispatch_lanes > 1`` the
+        whole assemble+transfer+launch runs on a lane thread, overlapping
+        the wire transfers of consecutive batches.  Results are collected
+        in dispatch order by :meth:`collect_ready` / :meth:`flush`.
         """
         if self._jit_fn is None:
             raise RuntimeError("runner not opened")
         t0 = time.monotonic()
+        self._batch_seq += 1
+        seq = self._batch_seq
+        if self._pool is not None:
+            self._pending.append(self._pool.submit(self._dispatch_work, list(records), t0, seq))
+        else:
+            self._pending.append(self._dispatch_work(records, t0, seq))
+
+    def _dispatch_work(self, records: typing.Sequence[typing.Any], t0: float, seq: int):
+        """Assemble + transfer + launch; returns (batch, output futures, timings)."""
         tvs = [
             r if isinstance(r, TensorValue) else coerce(r, self.method.input_schema)
             for r in records
         ]
-        self._batch_seq += 1
-        with annotate_batch(f"{self.model.name}.{self.method.name}", self._batch_seq):
+        with annotate_batch(f"{self.model.name}.{self.method.name}", seq):
+            t_a = time.monotonic()
             batch = assemble(tvs, self.method.input_schema, self.policy)
+            t_b = time.monotonic()
             inputs = self._transfer.to_device(batch)
             if self.method.needs_lengths:
                 lengths = self._transfer.lengths_to_device(batch)
                 outputs = self._jit_fn(self._params_on_device, inputs, lengths)
             else:
                 outputs = self._jit_fn(self._params_on_device, inputs)
-        self._pending.append((batch, outputs, t0))
+            t_c = time.monotonic()
+        timings = {
+            "t0": t0,
+            "assemble_s": t_b - t_a,
+            # On tunnel-attached devices the h2d wire transfer blocks inside
+            # the jitted-call dispatch, so this interval IS the transfer cost.
+            "dispatch_s": t_c - t_b,
+            "h2d_bytes": sum(a.nbytes for a in batch.arrays.values()),
+        }
+        return batch, outputs, timings
 
     def _fetch_oldest(self) -> typing.List[TensorValue]:
-        batch, outputs, t0 = self._pending.popleft()
+        item = self._pending.popleft()
+        if isinstance(item, concurrent.futures.Future):
+            item = item.result()  # re-raises lane-thread failures here
+        batch, outputs, timings = item
         host = DeviceTransfer.fetch(outputs)  # blocks on this batch only
         results = batch.unbatch(host)
         if self._metrics is not None:
-            dt = time.monotonic() - t0
+            dt = time.monotonic() - timings["t0"]
             self._metrics.meter("records").mark(len(results))
             self._metrics.histogram("batch_latency_s").record(dt)
             self._metrics.histogram("record_latency_s").record(dt / max(1, len(results)))
+            self._metrics.histogram("assemble_s").record(timings["assemble_s"])
+            self._metrics.histogram("dispatch_s").record(timings["dispatch_s"])
+            self._metrics.counter("h2d_bytes").inc(timings["h2d_bytes"])
             self._metrics.counter("batches").inc()
             self._metrics.counter("padded_records").inc(batch.padded_size - batch.num_records)
         return results
