@@ -14,6 +14,7 @@ the rest pickled) — see flink_tensorflow_tpu.checkpoint.store.
 from __future__ import annotations
 
 import threading
+import time
 import typing
 
 if typing.TYPE_CHECKING:
@@ -42,6 +43,10 @@ class CheckpointCoordinator:
         self.checkpoint_dir = checkpoint_dir
         self._next_id = 1
         self._lock = threading.Lock()
+        #: Serializes whole trigger() calls: a trigger arriving while one
+        #: is in flight (manual colliding with the periodic timer) queues
+        #: behind it instead of failing.
+        self._trigger_lock = threading.Lock()
         self._pending: typing.Optional[_PendingCheckpoint] = None
         self._completed: typing.List[int] = []
         #: Final snapshots of subtasks that finished (bounded jobs): used to
@@ -56,10 +61,25 @@ class CheckpointCoordinator:
 
     # -- trigger ----------------------------------------------------------
     def trigger(self, timeout: float = 60.0) -> typing.Dict[str, typing.Dict[int, typing.Any]]:
-        """Run one aligned checkpoint; returns {task: {subtask: snapshot}}."""
+        """Run one aligned checkpoint; returns {task: {subtask: snapshot}}.
+
+        Concurrent callers queue: if a checkpoint is already in flight
+        (e.g. a manual ``trigger_checkpoint`` colliding with the periodic
+        timer), the second call waits for the first to drain — within the
+        same ``timeout`` budget — and then runs its own checkpoint.
+        """
+        deadline = time.monotonic() + timeout
+        if not self._trigger_lock.acquire(timeout=timeout):
+            raise TimeoutError(
+                f"another checkpoint did not drain within {timeout}s"
+            )
+        try:
+            return self._trigger_locked(max(0.05, deadline - time.monotonic()))
+        finally:
+            self._trigger_lock.release()
+
+    def _trigger_locked(self, timeout: float) -> typing.Dict[str, typing.Dict[int, typing.Any]]:
         with self._lock:
-            if self._pending is not None:
-                raise RuntimeError("a checkpoint is already in flight")
             cid = self._next_id
             self._next_id += 1
             pending = _PendingCheckpoint(cid, self.executor.total_subtasks)

@@ -193,7 +193,9 @@ def build(num_classes: int = 1000, image_size: int = 299,
 
     def _prep(x):
         if uint8_input:
-            return x.astype(jnp.bfloat16) * (1.0 / 127.5) - 1.0
+            from flink_tensorflow_tpu.ops.preprocessing import inception_normalize
+
+            return inception_normalize(x)
         return x
 
     def serve(variables, inputs):

@@ -2,5 +2,19 @@
 optimally — see /opt/skills/guides/pallas_guide.md conventions."""
 
 from flink_tensorflow_tpu.ops.flash_attention import flash_attention
+from flink_tensorflow_tpu.ops.preprocessing import (
+    central_crop,
+    inception_normalize,
+    mnist_normalize,
+    normalize_image,
+    resize_bilinear,
+)
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "flash_attention",
+    "central_crop",
+    "inception_normalize",
+    "mnist_normalize",
+    "normalize_image",
+    "resize_bilinear",
+]
