@@ -1,0 +1,84 @@
+"""Typed job configuration — one validated dataclass per job.
+
+The reference threads job settings through Flink's untyped
+``Configuration``/``ParameterTool``/``GlobalJobParameters`` (SURVEY.md §5
+"Config / flag system"); SURVEY prescribes the rebuild use "a single typed
+config dataclass per job; no global flags".  ``JobConfig`` is that
+dataclass: every framework knob (checkpointing, channels, source pacing,
+device/mesh selection) lives here, is validated before the executor is
+built, and is frozen so a running job's configuration cannot drift.
+
+User-level parameters (the reference's ``GlobalJobParameters`` role —
+model paths, thresholds, anything a user function reads at runtime) go in
+``user_params``; the old untyped ``env.job_config`` dict is a deprecated
+alias for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Where and how often aligned snapshots persist."""
+
+    #: Directory for persisted snapshots; None disables persistence.
+    dir: typing.Optional[str] = None
+    #: Periodic trigger interval; None means manual triggers only.
+    interval_s: typing.Optional[float] = None
+    #: Budget for one aligned checkpoint to drain.
+    timeout_s: float = 60.0
+
+    def validate(self) -> None:
+        if self.interval_s is not None:
+            if self.dir is None:
+                raise ValueError("checkpoint.interval_s requires checkpoint.dir")
+            if self.interval_s <= 0:
+                raise ValueError(f"checkpoint.interval_s must be > 0, got {self.interval_s}")
+        if self.timeout_s <= 0:
+            raise ValueError(f"checkpoint.timeout_s must be > 0, got {self.timeout_s}")
+
+
+@dataclasses.dataclass(frozen=True)
+class JobConfig:
+    """All framework-level knobs for one job, validated at ``execute()``.
+
+    Fields mirror what the environment's fluent setters configure; the
+    setters are retained as conveniences that rebuild this config via
+    ``dataclasses.replace``.
+    """
+
+    #: Default operator parallelism (Flink's env-level parallelism).
+    parallelism: int = 1
+    #: Bounded capacity of inter-subtask channels (records).
+    channel_capacity: int = 1024
+    #: Sleep between source emissions — test/backpressure pacing.
+    source_throttle_s: float = 0.0
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    #: Assigns a jax device per (task_name, subtask_index) — operator DP.
+    device_provider: typing.Optional[typing.Callable[[str, int], typing.Any]] = None
+    #: Shared jax.sharding.Mesh for gang operators (DP/TP training).
+    mesh: typing.Optional[typing.Any] = None
+    #: User-level parameters readable from RuntimeContext (the reference's
+    #: GlobalJobParameters role).  Not interpreted by the framework.
+    user_params: typing.Mapping[str, typing.Any] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> "JobConfig":
+        if self.parallelism < 1:
+            raise ValueError(f"parallelism must be >= 1, got {self.parallelism}")
+        if self.channel_capacity < 1:
+            raise ValueError(
+                f"channel_capacity must be >= 1, got {self.channel_capacity}"
+            )
+        if self.source_throttle_s < 0:
+            raise ValueError(
+                f"source_throttle_s must be >= 0, got {self.source_throttle_s}"
+            )
+        if self.device_provider is not None and not callable(self.device_provider):
+            raise ValueError("device_provider must be callable (task, idx) -> device")
+        if self.mesh is not None and not hasattr(self.mesh, "devices"):
+            raise ValueError(f"mesh must be a jax.sharding.Mesh, got {type(self.mesh).__name__}")
+        self.checkpoint.validate()
+        return self

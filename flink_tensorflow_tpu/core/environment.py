@@ -12,8 +12,10 @@ from __future__ import annotations
 import dataclasses
 import time
 import typing
+import warnings
 
 from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.config import CheckpointConfig, JobConfig
 from flink_tensorflow_tpu.core.graph import DataflowGraph
 from flink_tensorflow_tpu.core.operators import SourceOperator
 from flink_tensorflow_tpu.core.runtime import LocalExecutor
@@ -64,22 +66,25 @@ class JobHandle:
 
 
 class StreamExecutionEnvironment:
-    def __init__(self, parallelism: int = 1):
+    def __init__(self, parallelism: int = 1, *, config: typing.Optional[JobConfig] = None):
         self.graph = DataflowGraph()
-        self.default_parallelism = parallelism
-        self.checkpoint_dir: typing.Optional[str] = None
-        self.checkpoint_interval_s: typing.Optional[float] = None
-        self.channel_capacity = 1024
-        self.device_provider: typing.Optional[typing.Callable[[str, int], typing.Any]] = None
-        self.mesh: typing.Optional[typing.Any] = None
-        self.job_config: typing.Dict[str, typing.Any] = {}
-        self.source_throttle_s = 0.0
+        if config is not None and parallelism != 1:
+            config = dataclasses.replace(config, parallelism=parallelism)
+        self.config: JobConfig = config or JobConfig(parallelism=parallelism)
         self.metric_registry = MetricRegistry()
 
     # -- configuration ----------------------------------------------------
-    def set_parallelism(self, parallelism: int) -> "StreamExecutionEnvironment":
-        self.default_parallelism = parallelism
+    # The typed JobConfig (core.config) is the single source of truth;
+    # the fluent setters and legacy attributes below rebuild it via
+    # dataclasses.replace so existing jobs keep working unchanged.
+
+    def configure(self, **changes) -> "StreamExecutionEnvironment":
+        """Replace JobConfig fields in one call: ``env.configure(channel_capacity=64)``."""
+        self.config = dataclasses.replace(self.config, **changes)
         return self
+
+    def set_parallelism(self, parallelism: int) -> "StreamExecutionEnvironment":
+        return self.configure(parallelism=parallelism)
 
     def enable_checkpointing(
         self, checkpoint_dir: str, interval_s: typing.Optional[float] = None
@@ -87,21 +92,105 @@ class StreamExecutionEnvironment:
         """Persist aligned snapshots under ``checkpoint_dir``; with
         ``interval_s`` they trigger periodically (Flink's checkpoint
         interval), otherwise only on explicit ``trigger_checkpoint``."""
-        self.checkpoint_dir = checkpoint_dir
-        self.checkpoint_interval_s = interval_s
-        return self
+        return self.configure(
+            checkpoint=dataclasses.replace(
+                self.config.checkpoint, dir=checkpoint_dir, interval_s=interval_s
+            )
+        )
 
     def set_device_provider(
         self, provider: typing.Callable[[str, int], typing.Any]
     ) -> "StreamExecutionEnvironment":
         """Assign a jax device per (task_name, subtask_index) — operator DP."""
-        self.device_provider = provider
-        return self
+        return self.configure(device_provider=provider)
 
     def set_mesh(self, mesh) -> "StreamExecutionEnvironment":
         """Share a jax.sharding.Mesh with gang operators (DP/TP training)."""
-        self.mesh = mesh
-        return self
+        return self.configure(mesh=mesh)
+
+    # -- legacy attribute surface (delegates to the typed config) ---------
+    @property
+    def default_parallelism(self) -> int:
+        return self.config.parallelism
+
+    @default_parallelism.setter
+    def default_parallelism(self, v: int) -> None:
+        self.configure(parallelism=v)
+
+    @property
+    def channel_capacity(self) -> int:
+        return self.config.channel_capacity
+
+    @channel_capacity.setter
+    def channel_capacity(self, v: int) -> None:
+        self.configure(channel_capacity=v)
+
+    @property
+    def source_throttle_s(self) -> float:
+        return self.config.source_throttle_s
+
+    @source_throttle_s.setter
+    def source_throttle_s(self, v: float) -> None:
+        self.configure(source_throttle_s=v)
+
+    @property
+    def checkpoint_dir(self) -> typing.Optional[str]:
+        return self.config.checkpoint.dir
+
+    @checkpoint_dir.setter
+    def checkpoint_dir(self, v: typing.Optional[str]) -> None:
+        self.configure(checkpoint=dataclasses.replace(self.config.checkpoint, dir=v))
+
+    @property
+    def checkpoint_interval_s(self) -> typing.Optional[float]:
+        return self.config.checkpoint.interval_s
+
+    @checkpoint_interval_s.setter
+    def checkpoint_interval_s(self, v: typing.Optional[float]) -> None:
+        self.configure(
+            checkpoint=dataclasses.replace(self.config.checkpoint, interval_s=v)
+        )
+
+    @property
+    def device_provider(self):
+        return self.config.device_provider
+
+    @device_provider.setter
+    def device_provider(self, v) -> None:
+        self.configure(device_provider=v)
+
+    @property
+    def mesh(self):
+        return self.config.mesh
+
+    @mesh.setter
+    def mesh(self, v) -> None:
+        self.configure(mesh=v)
+
+    @property
+    def job_config(self) -> typing.Dict[str, typing.Any]:
+        """DEPRECATED — untyped user-parameter dict; use
+        ``configure(user_params={...})`` (typed JobConfig) instead."""
+        warnings.warn(
+            "env.job_config is deprecated; use env.configure(user_params=...) "
+            "— framework knobs belong in the typed JobConfig",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        params = self.config.user_params
+        if not isinstance(params, dict):
+            params = dict(params)
+            self.configure(user_params=params)
+        return params
+
+    @job_config.setter
+    def job_config(self, v: typing.Mapping[str, typing.Any]) -> None:
+        warnings.warn(
+            "env.job_config is deprecated; use env.configure(user_params=...)",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        self.configure(user_params=dict(v))
 
     # -- sources ----------------------------------------------------------
     def from_collection(
@@ -122,15 +211,16 @@ class StreamExecutionEnvironment:
 
     # -- execution ---------------------------------------------------------
     def _make_executor(self) -> LocalExecutor:
+        cfg = self.config.validate()
         return LocalExecutor(
             self.graph,
-            channel_capacity=self.channel_capacity,
+            channel_capacity=cfg.channel_capacity,
             metric_registry=self.metric_registry,
-            device_provider=self.device_provider,
-            mesh=self.mesh,
-            job_config=self.job_config,
-            source_throttle_s=self.source_throttle_s,
-            checkpoint_dir=self.checkpoint_dir,
+            device_provider=cfg.device_provider,
+            mesh=cfg.mesh,
+            job_config=dict(cfg.user_params),
+            source_throttle_s=cfg.source_throttle_s,
+            checkpoint_dir=cfg.checkpoint.dir,
         )
 
     def execute(

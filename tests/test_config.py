@@ -1,0 +1,72 @@
+"""Typed JobConfig validation (SURVEY.md §5 "Config / flag system":
+"single typed config dataclass per job; no global flags")."""
+
+import dataclasses
+
+import pytest
+
+from flink_tensorflow_tpu import CheckpointConfig, JobConfig, StreamExecutionEnvironment
+
+
+def test_jobconfig_defaults_validate():
+    JobConfig().validate()
+
+
+def test_jobconfig_is_frozen():
+    cfg = JobConfig()
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        cfg.parallelism = 4
+
+
+@pytest.mark.parametrize(
+    "changes",
+    [
+        {"parallelism": 0},
+        {"channel_capacity": 0},
+        {"source_throttle_s": -1.0},
+        {"device_provider": "not-callable"},
+        {"mesh": object()},
+        {"checkpoint": CheckpointConfig(interval_s=1.0)},  # interval without dir
+        {"checkpoint": CheckpointConfig(dir="/tmp/x", interval_s=0.0)},
+        {"checkpoint": CheckpointConfig(timeout_s=0.0)},
+    ],
+)
+def test_jobconfig_rejects_bad_values(changes):
+    with pytest.raises(ValueError):
+        dataclasses.replace(JobConfig(), **changes).validate()
+
+
+def test_invalid_config_rejected_at_execute():
+    env = StreamExecutionEnvironment()
+    env.configure(channel_capacity=0)
+    env.from_collection([1, 2, 3]).sink_to_list()
+    with pytest.raises(ValueError, match="channel_capacity"):
+        env.execute(timeout=5)
+
+
+def test_env_setters_rebuild_config():
+    env = StreamExecutionEnvironment(parallelism=3)
+    assert env.config.parallelism == 3
+    env.channel_capacity = 7
+    env.enable_checkpointing("/tmp/ck", interval_s=2.0)
+    assert env.config.channel_capacity == 7
+    assert env.config.checkpoint == CheckpointConfig(dir="/tmp/ck", interval_s=2.0)
+    # Legacy attribute reads still work.
+    assert env.checkpoint_dir == "/tmp/ck"
+    assert env.default_parallelism == 3
+
+
+def test_env_accepts_config_instance():
+    cfg = JobConfig(parallelism=2, channel_capacity=16, user_params={"model": "x"})
+    env = StreamExecutionEnvironment(config=cfg)
+    assert env.config is cfg
+    out = env.from_collection([1, 2, 3]).map(lambda x: x + 1).sink_to_list()
+    env.execute(timeout=30)
+    assert sorted(out) == [2, 3, 4]
+
+
+def test_job_config_dict_is_deprecated_alias():
+    env = StreamExecutionEnvironment()
+    with pytest.deprecated_call():
+        env.job_config["model_path"] = "/m"
+    assert env.config.user_params == {"model_path": "/m"}
