@@ -33,10 +33,17 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: typing.Optional[bool] = None,
+    return_lse: bool = False,
 ):
     """Attention over ``[B, T, H, D]`` tensors (same layout/semantics as
     parallel.full_attention).  Block sizes shrink automatically for short
-    sequences; the stream layer's power-of-two buckets keep them aligned."""
+    sequences; the stream layer's power-of-two buckets keep them aligned.
+
+    ``return_lse=True`` also returns the per-row log-sum-exp
+    ``[B, H, T]`` (f32) — the residual that lets callers combine partial
+    attention over K/V shards, which is how the seq-axis ring
+    (parallel/ring_attention.py) folds this kernel's per-block outputs
+    into a global softmax without ever materializing full scores."""
     import jax
 
     b, t, h, d = q.shape
@@ -50,11 +57,25 @@ def flash_attention(
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    out = _flash_bh(
+    out, lse = _flash_bh(
         to_bh(q), to_bh(k), to_bh(v),
         causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse.reshape(b, h, t)  # drop the tiling-only unit dim
+    return out
+
+
+def _vma(*xs):
+    """Union of the operands' varying-mesh-axes sets — required on pallas
+    out_shapes when the kernel runs inside shard_map (check_vma=True)."""
+    import jax
+
+    out: frozenset = frozenset()
+    for x in xs:
+        out = out | getattr(jax.typeof(x), "vma", frozenset())
+    return out
 
 
 def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
@@ -68,7 +89,7 @@ def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
     nq, nk = t // block_q, tk // block_k
     scale = 1.0 / math.sqrt(d)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr):
         # Grid (bh, nq, nk): the innermost k dimension iterates
         # sequentially on TPU, so the VMEM scratch accumulators carry the
         # online softmax across K/V tiles — only ONE (block_k, d) K and V
@@ -115,8 +136,11 @@ def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
         @pl.when(j == nk - 1)
         def _finalize():
             l = l_scr[:, 0]
+            m = m_scr[:, 0]
             denom = jnp.where(l == 0.0, 1.0, l)
             o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+            # log-sum-exp residual; fully-masked rows (l=0, m=-inf) -> -inf.
+            lse_ref[0] = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(denom))[:, None]
 
     fn = pl.pallas_call(
         kernel,
@@ -129,9 +153,18 @@ def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b_, qi, j: (b_, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, qi, j: (b_, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, qi, j: (b_, qi, 0),
+                         memory_space=pltpu.VMEM),
+            # Trailing unit dim keeps the block's last-two dims TPU-tileable
+            # ((block_q, 1) instead of (1, block_q)).
+            pl.BlockSpec((1, block_q, 1), lambda b_, qi, j: (b_, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32, vma=_vma(q, k, v)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),

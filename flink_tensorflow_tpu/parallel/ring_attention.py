@@ -52,12 +52,41 @@ def _block_attention(q, k, v, m, l, o, mask):
     return m_new, l_new, o_new
 
 
-def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False):
+def _combine_blocks(o_acc, lse_acc, o_blk, lse_blk):
+    """Fold one block's normalized output+lse into the running pair.
+
+    Standard flash/ring recombination: with per-block softmax-normalized
+    outputs ``o_i`` and residuals ``lse_i``, the global softmax output is
+    ``sum_i o_i * exp(lse_i - lse_total)``.  o: [B, T, H, D] f32;
+    lse: [B, H, T] f32 (-inf = block contributed nothing to that row).
+    """
+    import jax.numpy as jnp
+
+    lse_new = jnp.logaddexp(lse_acc, lse_blk)
+    safe = jnp.where(jnp.isinf(lse_new), 0.0, lse_new)
+    c_acc = jnp.where(jnp.isinf(lse_acc), 0.0, jnp.exp(lse_acc - safe))
+    c_blk = jnp.where(jnp.isinf(lse_blk), 0.0, jnp.exp(lse_blk - safe))
+    o_new = (o_acc * c_acc.transpose(0, 2, 1)[..., None]
+             + o_blk * c_blk.transpose(0, 2, 1)[..., None])
+    return o_new, lse_new
+
+
+def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
+                           causal: bool = False, impl: str = "flash"):
     """Ring attention body — call INSIDE ``shard_map`` over ``axis_name``.
 
     q/k/v: the local shard ``[B, T_local, H, D]``.  Returns the local
     attention output shard ``[B, T_local, H, D]`` in q's dtype.
+
+    ``impl="flash"`` (default) computes each local block with the pallas
+    flash kernel (ops/flash_attention.py) and folds blocks together via
+    their log-sum-exp residuals; ``impl="einsum"`` keeps the composed-jnp
+    online-softmax path (golden baseline / debugging).
     """
+    if impl == "flash":
+        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal)
+    if impl != "einsum":
+        raise ValueError(f"impl must be 'flash' or 'einsum', got {impl!r}")
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -103,7 +132,71 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool =
     return out.astype(q.dtype)
 
 
-def ring_attention(mesh, q, k, v, *, causal: bool = False):
+def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
+    """Flash-kernel ring body: each K/V block runs through the pallas
+    kernel (MXU matmuls, O(block) VMEM), blocks merge via lse residuals.
+
+    Causal masking never reaches the kernel as a dynamic mask: a block is
+    either fully visible (source rank before mine — plain kernel), the
+    diagonal (source == mine — the kernel's own causal grid), or fully
+    masked (source after mine — skipped, lse=-inf), selected with
+    ``lax.switch`` on the traced source rank.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from flink_tensorflow_tpu.ops.flash_attention import flash_attention
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def block(k_blk, v_blk, step):
+        if not causal:
+            o, lse = flash_attention(q, k_blk, v_blk, return_lse=True)
+            return o.astype(jnp.float32), lse
+        src = (my - step) % n
+
+        def diag(args):
+            q_, k_, v_ = args
+            o, lse = flash_attention(q_, k_, v_, causal=True, return_lse=True)
+            return o.astype(jnp.float32), lse
+
+        def full(args):
+            q_, k_, v_ = args
+            o, lse = flash_attention(q_, k_, v_, return_lse=True)
+            return o.astype(jnp.float32), lse
+
+        def skip(args):
+            # Derive from q so outputs inherit q's varying mesh axes.
+            q_, _, _ = args
+            o = q_.astype(jnp.float32) * 0.0
+            lse = jnp.sum(o, axis=-1).transpose(0, 2, 1) - jnp.inf
+            return o, lse
+
+        idx = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+        return lax.switch(idx, [diag, full, skip], (q, k_blk, v_blk))
+
+    # Accumulators derived from q (shard_map vma rules, as in the einsum path).
+    o0 = q.astype(jnp.float32) * 0.0
+    lse0 = jnp.sum(o0, axis=-1).transpose(0, 2, 1) - jnp.inf
+
+    def body(i, carry):
+        k_blk, v_blk, o_acc, lse_acc = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        o_blk, lse_blk = block(k_blk, v_blk, i)
+        o_acc, lse_acc = _combine_blocks(o_acc, lse_acc, o_blk, lse_blk)
+        return k_blk, v_blk, o_acc, lse_acc
+
+    o_blk, lse_blk = block(k, v, 0)
+    o_acc, lse_acc = _combine_blocks(o0, lse0, o_blk, lse_blk)
+    _, _, o, _ = lax.fori_loop(1, n, body, (k, v, o_acc, lse_acc))
+    return o.astype(q.dtype)
+
+
+def ring_attention(mesh, q, k, v, *, causal: bool = False, impl: str = "flash"):
     """User-facing ring attention over a mesh with a ``seq`` axis.
 
     q/k/v: global ``[B, T, H, D]`` arrays (host or device); T must divide
@@ -118,10 +211,14 @@ def ring_attention(mesh, q, k, v, *, causal: bool = False):
     batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
     spec = P(batch_axis, SEQ_AXIS, None, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention_sharded, causal=causal),
+        functools.partial(ring_attention_sharded, causal=causal, impl=impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs don't yet thread varying-mesh-axes through
+        # the interpret-mode lowering (dynamic_slice vma mismatch), so the
+        # flash body runs with vma checking off; einsum keeps it on.
+        check_vma=impl != "flash",
     )
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))

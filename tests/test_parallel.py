@@ -100,23 +100,28 @@ class TestDPTraining:
 
 
 class TestRingAttention:
+    @pytest.mark.parametrize("impl", ["flash", "einsum"])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_full_attention(self, causal):
+    def test_matches_full_attention(self, causal, impl):
+        """Both ring bodies — the pallas flash kernel (interpret mode on
+        CPU: same code path as TPU) and the composed-jnp baseline — must
+        reproduce unsharded attention exactly."""
         mesh = make_mesh({"seq": 8})
         rng = np.random.RandomState(2)
         b, t, h, d = 2, 64, 4, 16
         q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
 
         want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal)
-        got = ring_attention(mesh, q, k, v, causal=causal)
+        got = ring_attention(mesh, q, k, v, causal=causal, impl=impl)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
-    def test_seq_with_data_axis(self):
+    @pytest.mark.parametrize("impl", ["flash", "einsum"])
+    def test_seq_with_data_axis(self, impl):
         """seq + data axes compose: [B,T,H,D] with B over data, T over seq."""
         mesh = make_mesh({"data": 2, "seq": 4})
         rng = np.random.RandomState(3)
         b, t, h, d = 4, 32, 2, 8
         q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
         want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
-        got = ring_attention(mesh, q, k, v)
+        got = ring_attention(mesh, q, k, v, impl=impl)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
