@@ -12,6 +12,7 @@ from flink_tensorflow_tpu.models.loaders import (
     freeze_method,
     save_bundle,
 )
+from flink_tensorflow_tpu.models.tf_loader import TFGraphDefLoader, TFSavedModelLoader
 from flink_tensorflow_tpu.models.zoo.registry import ModelDef, get_model_def
 
 __all__ = [
@@ -20,6 +21,8 @@ __all__ = [
     "ModelDef",
     "ModelMethod",
     "SavedModelLoader",
+    "TFGraphDefLoader",
+    "TFSavedModelLoader",
     "freeze_method",
     "get_model_def",
     "save_bundle",

@@ -106,3 +106,112 @@ class TFSavedModelLoader:
         name = f"tf_savedmodel:{self.path}"
         return Model(name, params={}, methods={"serve": method},
                      metadata={"source": self.path, "signature": self.signature})
+
+
+class TFGraphDefLoader:
+    """Loads a frozen TF ``GraphDef`` (.pb bytes or file) into a
+    framework :class:`Model`.
+
+    The reference's ``GraphLoader`` imports frozen graph bytes into a TF
+    ``Graph`` and feeds/fetches named tensors through an embedded session
+    (BASELINE.json:5; SURVEY.md §2 row "GraphLoader") — the artifact its
+    flagship Inception example actually ships.  Here the same bytes are
+    imported into a TF-v1 ``wrap_function`` graph, pruned to a
+    ConcreteFunction over the requested feed/fetch tensors, and inlined
+    into XLA via ``jax2tf.call_tf`` — frozen weights are constants in the
+    GraphDef, so the lowered executable is fully self-contained.
+
+    ``inputs``/``outputs`` map record-field / output names to graph
+    tensor names (``"x:0"``); a bare tensor-name sequence uses the op
+    names as field names.
+    """
+
+    def __init__(
+        self,
+        graph_def: typing.Union[bytes, str],
+        *,
+        inputs: typing.Union[typing.Mapping[str, str], typing.Sequence[str]],
+        outputs: typing.Union[typing.Mapping[str, str], typing.Sequence[str]],
+    ):
+        self.graph_def = graph_def
+        self.inputs = self._as_mapping(inputs)
+        self.outputs = self._as_mapping(outputs)
+
+    @staticmethod
+    def _as_mapping(spec) -> typing.Dict[str, str]:
+        if isinstance(spec, typing.Mapping):
+            return dict(spec)
+        return {t.split(":")[0].rsplit("/", 1)[-1]: t for t in spec}
+
+    def _graph_def_bytes(self) -> bytes:
+        if isinstance(self.graph_def, bytes):
+            return self.graph_def
+        with open(self.graph_def, "rb") as f:
+            return f.read()
+
+    def _pruned(self):
+        """Import the frozen graph and prune to feeds -> fetches."""
+        try:
+            import tensorflow as tf
+        except ImportError as exc:
+            raise ImportError(
+                "TFGraphDefLoader requires tensorflow; for non-TF artifacts "
+                "use models.loaders.GraphLoader (jax.export format)"
+            ) from exc
+
+        gd = tf.compat.v1.GraphDef()
+        gd.ParseFromString(self._graph_def_bytes())
+
+        def _import():
+            tf.compat.v1.import_graph_def(gd, name="")
+
+        wrapped = tf.compat.v1.wrap_function(_import, [])
+        try:
+            feeds = [wrapped.graph.as_graph_element(t) for t in self.inputs.values()]
+            fetches = [wrapped.graph.as_graph_element(t) for t in self.outputs.values()]
+        except KeyError as exc:
+            names = sorted(op.name for op in wrapped.graph.get_operations())
+            raise KeyError(
+                f"tensor not found in frozen graph: {exc}; ops present: {names[:20]}..."
+            ) from exc
+        return wrapped.prune(feeds, fetches)
+
+    def input_schema(self, pruned=None) -> RecordSchema:
+        """Per-record schema from the pruned feeds (leading None batch
+        dim stripped, as in :meth:`TFSavedModelLoader.input_schema`)."""
+        pruned = pruned or self._pruned()
+        fields = {}
+        for name, tensor in zip(self.inputs, pruned.inputs):
+            dims = tensor.shape.as_list()
+            shape = tuple(dims[1:]) if dims and dims[0] is None else tuple(dims)
+            fields[name] = TensorSpec(shape, np.dtype(tensor.dtype.as_numpy_dtype))
+        return RecordSchema(fields)
+
+    def load(self) -> Model:
+        """-> Model whose "serve" method runs the frozen graph inside XLA."""
+        from jax.experimental import jax2tf
+
+        pruned = self._pruned()
+        schema = self.input_schema(pruned)
+        input_order = list(self.inputs)
+        output_order = list(self.outputs)
+        call = jax2tf.call_tf(pruned)
+
+        def serve(params, inputs):
+            del params  # frozen weights are constants in the GraphDef
+            out = call(*[inputs[n] for n in input_order])
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            return dict(zip(output_order, out))
+
+        method = ModelMethod(
+            name="serve",
+            input_schema=schema,
+            output_names=tuple(output_order),
+            fn=serve,
+        )
+        source = self.graph_def if isinstance(self.graph_def, str) else "<bytes>"
+        return Model(f"tf_graphdef:{source}", params={},
+                     methods={"serve": method},
+                     metadata={"source": source, "inputs": self.inputs,
+                               "outputs": self.outputs})

@@ -1,0 +1,103 @@
+"""Frozen TF GraphDef artifacts on the streaming path — the reference's
+``GraphLoader`` contract (BASELINE.json:5; SURVEY.md §2 row "GraphLoader":
+frozen graph bytes -> feeds/fetches by tensor name).  The fixture freezes
+a real TF model (variables -> constants) exactly the way TF-zoo .pb files
+like the reference's Inception example were produced."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment  # noqa: E402
+from flink_tensorflow_tpu.functions import ModelWindowFunction  # noqa: E402
+from flink_tensorflow_tpu.models import TFGraphDefLoader  # noqa: E402
+from flink_tensorflow_tpu.tensors import TensorValue  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def frozen_pb(tmp_path_factory):
+    """A small conv net frozen to a GraphDef file, plus a golden I/O pair."""
+    from tensorflow.python.framework import convert_to_constants
+
+    class Net(tf.Module):
+        def __init__(self):
+            init = tf.random.stateless_normal
+            self.kernel = tf.Variable(init((3, 3, 1, 4), seed=[0, 1]), name="k")
+            self.w = tf.Variable(init((7 * 7 * 4, 3), seed=[2, 3]), name="w")
+
+        @tf.function(input_signature=[tf.TensorSpec([None, 14, 14, 1], tf.float32,
+                                                    name="image")])
+        def forward(self, image):
+            h = tf.nn.conv2d(image, self.kernel, strides=2, padding="SAME")
+            h = tf.nn.relu(h)
+            logits = tf.reshape(h, [-1, 7 * 7 * 4]) @ self.w
+            return tf.identity(logits, name="logits")
+
+    net = Net()
+    concrete = net.forward.get_concrete_function()
+    frozen = convert_to_constants.convert_variables_to_constants_v2(concrete)
+    path = str(tmp_path_factory.mktemp("pb") / "net.pb")
+    with open(path, "wb") as f:
+        f.write(frozen.graph.as_graph_def().SerializeToString())
+
+    x = np.random.RandomState(0).randn(2, 14, 14, 1).astype(np.float32)
+    want = concrete(tf.constant(x)).numpy()
+    in_name = frozen.inputs[0].name
+    out_name = frozen.outputs[0].name
+    return path, in_name, out_name, x, want
+
+
+class TestTFGraphDefLoader:
+    def test_schema_from_frozen_graph(self, frozen_pb):
+        path, in_name, out_name, _, _ = frozen_pb
+        loader = TFGraphDefLoader(path, inputs={"image": in_name},
+                                  outputs={"logits": out_name})
+        schema = loader.input_schema()
+        assert schema["image"].shape == (14, 14, 1)
+        assert schema["image"].dtype == np.float32
+
+    def test_jax_output_matches_tf(self, frozen_pb):
+        path, in_name, out_name, x, want = frozen_pb
+        model = TFGraphDefLoader(path, inputs={"image": in_name},
+                                 outputs={"logits": out_name}).load()
+        got = jax.jit(model.method("serve").fn)(model.params, {"image": x})
+        np.testing.assert_allclose(np.asarray(got["logits"]), want, atol=1e-5)
+
+    def test_accepts_raw_bytes(self, frozen_pb):
+        path, in_name, out_name, x, want = frozen_pb
+        with open(path, "rb") as f:
+            pb_bytes = f.read()
+        model = TFGraphDefLoader(pb_bytes, inputs=[in_name],
+                                 outputs=[out_name]).load()
+        (out_field,) = model.method("serve").output_names
+        got = jax.jit(model.method("serve").fn)(model.params, {"image": x})
+        np.testing.assert_allclose(np.asarray(got[out_field]), want, atol=1e-5)
+
+    def test_frozen_graph_in_stream(self, frozen_pb):
+        """The reference's flagship shape: a frozen .pb serving a stream."""
+        path, in_name, out_name, _, _ = frozen_pb
+        model = TFGraphDefLoader(path, inputs={"image": in_name},
+                                 outputs={"logits": out_name}).load()
+        rng = np.random.RandomState(1)
+        records = [TensorValue({"image": rng.randn(14, 14, 1).astype(np.float32)},
+                               {"i": i}) for i in range(10)]
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_collection(records)
+            .count_window(5)
+            .apply(ModelWindowFunction(model))
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert len(out) == 10
+        assert sorted(r.meta["i"] for r in out) == list(range(10))
+        assert all(r["logits"].shape == (3,) for r in out)
+
+    def test_missing_tensor_name(self, frozen_pb):
+        path, in_name, _, _, _ = frozen_pb
+        with pytest.raises(KeyError, match="not found"):
+            TFGraphDefLoader(path, inputs={"image": in_name},
+                             outputs={"y": "nope:0"}).load()
