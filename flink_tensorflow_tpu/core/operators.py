@@ -244,7 +244,16 @@ class WindowOperator(_FunctionOperator):
             seq = self._window_seq.get(key, 0)
             buf = WindowBuffer(window=CountWindow(seq))
             self._buffers[key] = buf
-        buf.add(record.value, record.timestamp)
+        value = record.value
+        # Zero-copy ingestion: tensor window functions may take the record
+        # payload NOW (into their ring arena) and buffer only a token —
+        # non-keyed only, so buffer order equals arena FIFO order.
+        ingest = getattr(self.function, "ingest_element", None)
+        if ingest is not None and self.key_selector is None:
+            token = ingest(value, self._collector)
+            if token is not None:
+                value = token
+        buf.add(value, record.timestamp)
         if self.trigger.on_element(buf):
             self._fire(key, buf)
 
@@ -291,6 +300,14 @@ class WindowOperator(_FunctionOperator):
     def _operator_snapshot(self):
         from flink_tensorflow_tpu.core.windows import snapshot_buffers
 
+        # Ring tokens hold no payload: copy buffered records out of the
+        # arena so the snapshot is self-contained (the post-snapshot run
+        # continues on the materialized values; fresh elements re-enter
+        # the ring).
+        materialize = getattr(self.function, "materialize_tokens", None)
+        if materialize is not None:
+            for buf in self._buffers.values():
+                buf.elements = materialize(buf.elements)
         return {"buffers": snapshot_buffers(self._buffers), "seq": dict(self._window_seq)}
 
     def _operator_restore(self, state):

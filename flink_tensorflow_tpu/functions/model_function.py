@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import typing
 
+import numpy as np
+
 from flink_tensorflow_tpu.core import functions as fn
 from flink_tensorflow_tpu.functions.runner import CompiledMethodRunner
 from flink_tensorflow_tpu.models.base import Model
@@ -116,6 +118,16 @@ class ModelMapFunction(_ModelFunctionBase, fn.MapFunction):
         return self.runner.run_batch([value])[0]
 
 
+class _RingToken:
+    """Placeholder in the window buffer for a record whose payload lives in
+    the ring arena (zero-copy path); carries only the record's metadata."""
+
+    __slots__ = ("meta",)
+
+    def __init__(self, meta):
+        self.meta = meta
+
+
 class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
     """Micro-batch inference: one jitted call per fired window.
 
@@ -132,11 +144,24 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
     stay fed.  In-flight batches are flushed at end of input and before
     every state snapshot, so barriers never have results in limbo
     (exactly-once, SURVEY.md §7 hard part 5).
+
+    **Zero-copy ring buffering** (``use_ring``): with a static input
+    schema and a ``fixed_batch`` policy, arriving records are written
+    once into a :class:`~flink_tensorflow_tpu.native.ring.TensorRing`
+    (the window buffer holds only metadata tokens) and a window fire
+    claims ``[B, ...]`` numpy views onto the arena that feed
+    ``jax.device_put`` directly — no stacking copy on the steady-state
+    path (BASELINE.json "zero-copy Row<->DeviceArray marshalling").
+    Slots recycle when the batch's results are fetched, so the arena is
+    sized ``(pipeline_depth + 2) * fixed_batch`` slots.  Default: auto
+    (on when eligible); pass ``use_ring=False`` to force the list path.
     """
 
     def __init__(self, model: ModelSource, method: str = "serve", *,
                  pipeline_depth: typing.Optional[int] = None,
-                 idle_flush_s: float = 0.05, **kw):
+                 idle_flush_s: float = 0.05,
+                 use_ring: typing.Optional[bool] = None,
+                 ring_capacity: typing.Optional[int] = None, **kw):
         super().__init__(model, method, **kw)
         if pipeline_depth is None:
             pipeline_depth = 2 * self._transfer_lanes
@@ -145,19 +170,168 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
         self._max_in_flight = pipeline_depth - 1
         self._idle_flush_s = idle_flush_s
         self._last_dispatch: typing.Optional[float] = None
+        self._use_ring = use_ring
+        self._ring_capacity = ring_capacity
+        self._ring = None
+        self._last_ingested: typing.Optional[TensorValue] = None
 
+    # -- ring lifecycle ----------------------------------------------------
+    def open(self, ctx) -> None:
+        super().open(ctx)
+        if self._use_ring is False:
+            return
+        method = self.runner.method
+        schema = method.input_schema
+        static = all(
+            all(d is not None for d in schema[n].shape) for n in schema.names
+        )
+        eligible = static and not method.needs_lengths
+        fixed = self.runner.policy.fixed_batch
+        if self._ring_capacity is None and fixed is not None:
+            # One slot set per in-flight batch + the accumulating window.
+            self._ring_capacity = (self._max_in_flight + 3) * fixed
+        if self._use_ring and not eligible:
+            raise ValueError(
+                "use_ring=True requires a fully-static input schema "
+                "(dynamic-length fields batch through the list path)"
+            )
+        if self._use_ring and self._ring_capacity is None:
+            raise ValueError("use_ring=True without fixed_batch needs ring_capacity")
+        if eligible and self._ring_capacity is not None:
+            from flink_tensorflow_tpu.native.ring import TensorRing
+
+            self._ring = TensorRing(schema, self._ring_capacity)
+
+    def clone(self) -> "fn.Function":
+        dup = super().clone()
+        dup._ring = None
+        dup._last_ingested = None
+        return dup
+
+    def close(self) -> None:
+        super().close()
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+
+    # -- per-element ingestion (WindowOperator hook) -----------------------
+    def ingest_element(self, value, out: fn.Collector):
+        """Write one record into the ring at arrival; returns the buffer
+        token, or None to buffer the value itself (ring off/full)."""
+        if self._ring is None:
+            return None
+        tv = value if isinstance(value, TensorValue) else coerce(
+            value, self.runner.method.input_schema)
+        while not self._ring.try_push(tv.fields):
+            # Ring full: the oldest in-flight batch holds slots — collect
+            # it (releases on fetch) and retry.  No in-flight work means
+            # the buffered window alone exceeds capacity: list-buffer it.
+            if not self.runner._pending:
+                return None
+            for record in self.runner.collect_ready(len(self.runner._pending) - 1):
+                out.collect(record)
+        self._last_ingested = tv
+        return _RingToken(tv.meta)
+
+    def materialize_tokens(self, elements):
+        """Replace ring tokens with concrete TensorValues (copy-out) —
+        used before operator snapshots and on mixed buffers.  In-flight
+        batches must be flushed first so the ring head is the buffer."""
+        tokens = [e for e in elements if isinstance(e, _RingToken)]
+        if not tokens:
+            return list(elements)
+        if self.runner is not None and self.runner._pending:
+            for record in self.runner.flush():
+                if self._out is not None:
+                    self._out.collect(record)
+        values = {}
+        remaining = len(tokens)
+        idx = 0
+        while remaining > 0:
+            views, n = self._ring.claim_batch(remaining)
+            if n == 0:
+                raise RuntimeError("ring out of sync with window buffer")
+            for i in range(n):
+                values[idx] = {f: np.array(v[i]) for f, v in views.items()}
+                idx += 1
+            self._ring.release(n)
+            remaining -= n
+        out = []
+        ti = 0
+        for e in elements:
+            if isinstance(e, _RingToken):
+                out.append(TensorValue(values[ti], e.meta))
+                ti += 1
+            else:
+                out.append(e)
+        return out
+
+    # -- firing ------------------------------------------------------------
     def process_window(self, key, window, elements, out: fn.Collector):
         import time
 
         elements = list(elements)
+        self._out = out
+        tokens = all(isinstance(e, _RingToken) for e in elements) and bool(elements)
+        if tokens and self._ring is not None:
+            self._fire_ring(elements, out)
+        else:
+            if any(isinstance(e, _RingToken) for e in elements):
+                # Mixed (restored values + fresh tokens): copy tokens out
+                # and take the list path for this window only.
+                elements = self.materialize_tokens(elements)
+            policy = self.runner.policy
+            cap = policy.fixed_batch or policy.batch.sizes[-1]
+            for i in range(0, len(elements), cap):
+                self.runner.dispatch(elements[i:i + cap])
+                for record in self.runner.collect_ready(self._max_in_flight):
+                    out.collect(record)
+        self._last_dispatch = time.monotonic()
+
+    def _fire_ring(self, tokens, out: fn.Collector):
+        """Claim contiguous arena views per chunk and dispatch them —
+        the zero-copy fire path."""
+        from flink_tensorflow_tpu.tensors.batching import Batch
+
         policy = self.runner.policy
         cap = policy.fixed_batch or policy.batch.sizes[-1]
-        for i in range(0, len(elements), cap):
-            self.runner.dispatch(elements[i:i + cap])
+        n_total = len(tokens)
+        for start in range(0, n_total, cap):
+            chunk = tokens[start:start + cap]
+            n = len(chunk)
+            b = policy.batch_bucket(n)
+            # Pad slots: replay the last ingested record so the padded
+            # rows are benign; they sit contiguously after the chunk.
+            for _ in range(b - n):
+                if not self._ring.try_push(self._last_ingested.fields):
+                    raise RuntimeError("ring cannot hold batch padding; "
+                                       "raise ring_capacity")
+            views, got = self._ring.claim_batch(b)
+            if got < b:
+                # Arena wraparound split this batch: copy out (rare; at
+                # most once per trip around the ring).
+                arrays = {f: np.empty((b, *v.shape[1:]), v.dtype)
+                          for f, v in views.items()}
+                filled = 0
+                while filled < b:
+                    if filled:
+                        views, got = self._ring.claim_batch(b - filled)
+                    for f, v in views.items():
+                        arrays[f][filled:filled + got] = v[:got]
+                    self._ring.release(got)
+                    filled += got
+                release = None
+            else:
+                arrays = views
+                ring = self._ring
+                release = (lambda nn=b, r=ring: r.release(nn))
+            valid = np.zeros((b,), dtype=bool)
+            valid[:n] = True
+            batch = Batch(arrays=arrays, valid=valid, lengths={},
+                          metas=[t.meta for t in chunk])
+            self.runner.dispatch_batch(batch, on_done=release)
             for record in self.runner.collect_ready(self._max_in_flight):
                 out.collect(record)
-        self._last_dispatch = time.monotonic()
-        self._out = out
 
     # Timer hooks (WindowOperator.next_deadline/fire_due): if the stream
     # goes quiet with batches in flight, flush them after idle_flush_s —

@@ -167,15 +167,42 @@ class CompiledMethodRunner:
         else:
             self._pending.append(self._dispatch_work(records, t0, seq))
 
+    def dispatch_batch(self, batch: Batch, *, assemble_s: float = 0.0,
+                       on_done: typing.Optional[typing.Callable[[], None]] = None) -> None:
+        """Transfer + launch a pre-assembled :class:`Batch` (zero-copy ring
+        path: ``batch.arrays`` are views onto the ring arena).
+
+        ``on_done`` fires when the batch's results are FETCHED — the point
+        after which the arena slots are provably no longer read by the
+        executable (fetch order == dispatch order, so ring releases stay
+        FIFO).  Releasing earlier would let the producer overwrite slots
+        that a CPU-backend ``device_put`` aliases zero-copy.
+        """
+        if self._jit_fn is None:
+            raise RuntimeError("runner not opened")
+        t0 = time.monotonic()
+        self._batch_seq += 1
+        seq = self._batch_seq
+        if self._pool is not None:
+            self._pending.append(self._pool.submit(
+                self._launch_batch, batch, t0, seq, assemble_s, on_done))
+        else:
+            self._pending.append(self._launch_batch(batch, t0, seq, assemble_s, on_done))
+
     def _dispatch_work(self, records: typing.Sequence[typing.Any], t0: float, seq: int):
         """Assemble + transfer + launch; returns (batch, output futures, timings)."""
         tvs = [
             r if isinstance(r, TensorValue) else coerce(r, self.method.input_schema)
             for r in records
         ]
+        t_a = time.monotonic()
+        batch = assemble(tvs, self.method.input_schema, self.policy)
+        return self._launch_batch(batch, t0, seq, time.monotonic() - t_a, None)
+
+    def _launch_batch(self, batch: Batch, t0: float, seq: int,
+                      assemble_s: float, on_done):
+        """Transfer + launch; returns (batch, output futures, timings, on_done)."""
         with annotate_batch(f"{self.model.name}.{self.method.name}", seq):
-            t_a = time.monotonic()
-            batch = assemble(tvs, self.method.input_schema, self.policy)
             t_b = time.monotonic()
             inputs = self._transfer.to_device(batch)
             if self.method.needs_lengths:
@@ -186,21 +213,23 @@ class CompiledMethodRunner:
             t_c = time.monotonic()
         timings = {
             "t0": t0,
-            "assemble_s": t_b - t_a,
+            "assemble_s": assemble_s,
             # On tunnel-attached devices the h2d wire transfer blocks inside
             # the jitted-call dispatch, so this interval IS the transfer cost.
             "dispatch_s": t_c - t_b,
             "h2d_bytes": sum(a.nbytes for a in batch.arrays.values()),
         }
-        return batch, outputs, timings
+        return batch, outputs, timings, on_done
 
     def _fetch_oldest(self) -> typing.List[TensorValue]:
         item = self._pending.popleft()
         if isinstance(item, concurrent.futures.Future):
             item = item.result()  # re-raises lane-thread failures here
-        batch, outputs, timings = item
+        batch, outputs, timings, on_done = item
         host = DeviceTransfer.fetch(outputs)  # blocks on this batch only
         results = batch.unbatch(host)
+        if on_done is not None:
+            on_done()
         if self._metrics is not None:
             dt = time.monotonic() - timings["t0"]
             self._metrics.meter("records").mark(len(results))

@@ -201,6 +201,13 @@ class TensorRing:
         ring_cls = _NativeRing if self.is_native else _PyRing
         self._ring = ring_cls(self.slot_size, capacity)
         self.capacity = self._ring.n_slots
+        #: Pipelining cursor: slots claimed but not yet released.  The
+        #: low-level rings claim from ``head`` (which only moves on
+        #: release), so overlapping claims — several dispatched batches
+        #: in flight at once — are sequenced here.  Claims and releases
+        #: must both happen on the single consumer thread (SPSC).
+        self._claim_ahead = 0
+        self._claim_idx = 0
 
     # -- producer ----------------------------------------------------------
     def try_push(self, record: typing.Mapping[str, np.ndarray]) -> bool:
@@ -230,10 +237,17 @@ class TensorRing:
 
     def claim_batch(self, max_n: int) -> typing.Tuple[typing.Dict[str, np.ndarray], int]:
         """Claim up to ``max_n`` contiguous records; returns ({field ->
-        [n, ...] zero-copy view}, n).  Call :meth:`release` when done."""
-        start, n = self._ring.pop_claim(max_n)
-        if n == 0:
+        [n, ...] zero-copy view}, n).  Call :meth:`release` when done.
+
+        Claims may overlap (claim B while A's views are still in use);
+        releases apply oldest-claim-first."""
+        ready = self._ring.poppable() - self._claim_ahead
+        if ready <= 0:
             return {}, 0
+        start = self._claim_idx
+        n = min(max_n, ready, self.capacity - start)
+        self._claim_ahead += n
+        self._claim_idx = (start + n) % self.capacity
         arena = self._ring.arena_view()
         views = {}
         for name, (offset, shape, dtype) in self.layout.items():
@@ -252,6 +266,7 @@ class TensorRing:
 
     def release(self, count: int) -> None:
         self._ring.pop_release(count)
+        self._claim_ahead -= count
 
     def close(self) -> None:
         self._ring.destroy()
