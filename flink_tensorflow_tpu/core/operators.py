@@ -341,10 +341,17 @@ class SourceOperator(_FunctionOperator):
         """Yields values; the caller must call :meth:`record_emitted` after
         each downstream emit so a barrier between yield and emit never
         counts the in-flight record as already emitted."""
-        it = self.function.run()
         # Replay: skip records already emitted before the restored snapshot.
-        for _ in range(self._restored_offset):
-            next(it, None)
+        # Sources that know how to reposition (e.g. PacedSource, which must
+        # not re-run its sleep schedule for skipped records) expose seek();
+        # everything else replays by consuming the iterator.
+        if self._restored_offset and hasattr(self.function, "seek"):
+            self.function.seek(self._restored_offset)
+            it = self.function.run()
+        else:
+            it = self.function.run()
+            for _ in range(self._restored_offset):
+                next(it, None)
         self.offset = self._restored_offset
         yield from it
 

@@ -1,7 +1,8 @@
 from flink_tensorflow_tpu.io.sources import (
     CollectionSource,
     GeneratorSource,
+    PacedSource,
     ThrottledSource,
 )
 
-__all__ = ["CollectionSource", "GeneratorSource", "ThrottledSource"]
+__all__ = ["CollectionSource", "GeneratorSource", "PacedSource", "ThrottledSource"]
