@@ -1,0 +1,323 @@
+"""Multi-host data-parallel training with cohort supervision.
+
+The reference's cluster story (SURVEY.md §1 L1, §3.5): a JobManager
+schedules subtasks onto TaskManagers; DP training crosses processes via
+TF ClusterSpec + NCCL.  The TPU-native cohort (SURVEY.md §7 step 8):
+
+- a **CohortSupervisor** (parent mode, the JobManager analogue) spawns N
+  identical worker processes and restarts the whole cohort from the last
+  COMMON checkpoint on any worker loss (XLA meshes cannot shrink live);
+- each **worker** joins the jax.distributed cohort, forms the global
+  mesh, and runs the SAME streaming job: its partition of the record
+  stream -> count windows of ``global_batch/N`` -> a gang
+  DPTrainWindowFunction whose pjit-ed step spans every host's devices
+  (gradient allreduce compiled by XLA, zero communication code here);
+- checkpoints use **count-based barriers** (``every_n_records``) so all
+  hosts snapshot at identical stream positions — the property that makes
+  per-host snapshots cohort-consistent;
+- after training, every worker ships its loss stream over the **remote
+  record plane** (RemoteSink -> fan-in RemoteSource on worker 0), which
+  aggregates them — the cross-process record exchange the reference does
+  with Flink's Netty shuffle.
+
+Run (2 processes, 8 virtual CPU devices total, one injected failure):
+  python examples/multihost_dp_train.py --records-per-worker 48
+Clean run:  python examples/multihost_dp_train.py --no-failure
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--devices-per-worker", type=int, default=4)
+    p.add_argument("--records-per-worker", type=int, default=48)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--ckpt-every-steps", type=int, default=2)
+    p.add_argument("--base-port", type=int, default=0,
+                   help="0 = pick free ports automatically")
+    p.add_argument("--no-failure", action="store_true",
+                   help="skip the injected worker failure")
+    p.add_argument("--fail-worker", type=int, default=1)
+    p.add_argument("--fail-at-step", type=int, default=5)
+    p.add_argument("--work-dir", default=None)
+    # worker-mode internals (set by the parent)
+    p.add_argument("--worker", type=int, default=None)
+    p.add_argument("--attempt", type=int, default=0)
+    p.add_argument("--coordinator-port", type=int, default=None)
+    p.add_argument("--agg-port", type=int, default=None)
+    return p
+
+
+def _model_and_schema():
+    import numpy as np
+
+    from flink_tensorflow_tpu.models import get_model_def
+    from flink_tensorflow_tpu.tensors import RecordSchema, spec
+
+    cfg = dict(hash_buckets=200, embed_dim=4, num_cat_slots=2,
+               num_dense=4, num_wide=8, hidden=(16,))
+    mdef = get_model_def("widedeep", **cfg)
+    schema = RecordSchema({
+        "wide": spec((cfg["num_wide"],)),
+        "dense": spec((cfg["num_dense"],)),
+        "cat": spec((cfg["num_cat_slots"],), np.int32),
+        "label": spec((), np.int32),
+    })
+    return mdef, schema, cfg
+
+
+def _worker_records(worker, n, cfg):
+    """Worker ``worker``'s stream partition, deterministic per worker —
+    replay after a cohort restart regenerates identical records."""
+    import numpy as np
+
+    from flink_tensorflow_tpu.tensors import TensorValue
+
+    rng = np.random.RandomState(1000 + worker)
+    records = []
+    for i in range(n):
+        x_wide = rng.rand(cfg["num_wide"]).astype(np.float32)
+        records.append(TensorValue({
+            "wide": x_wide,
+            "dense": rng.rand(cfg["num_dense"]).astype(np.float32),
+            "cat": rng.randint(0, cfg["hash_buckets"], (cfg["num_cat_slots"],)).astype(np.int32),
+            "label": np.int32(x_wide[0] > 0.5),
+        }, meta={"id": i, "worker": worker}))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# worker mode
+# ---------------------------------------------------------------------------
+
+def run_worker(args) -> int:
+    from flink_tensorflow_tpu.utils.platform import force_cpu
+
+    force_cpu(args.devices_per_worker)
+    import jax
+    import optax
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment
+    from flink_tensorflow_tpu.functions import DPTrainWindowFunction
+    from flink_tensorflow_tpu.parallel import latest_common_checkpoint, multihost
+
+    topo = multihost.initialize(
+        f"localhost:{args.coordinator_port}",
+        num_processes=args.workers,
+        process_id=args.worker,
+    )
+    mesh = multihost.global_mesh({"data": topo.global_devices})
+
+    mdef, schema, cfg = _model_and_schema()
+    local_batch = args.global_batch // args.workers
+    records = _worker_records(args.worker, args.records_per_worker, cfg)
+    total_steps = args.records_per_worker // local_batch
+
+    ckpt_root = os.path.join(args.work_dir, "ckpt")
+    my_ckpt = os.path.join(ckpt_root, f"w{args.worker}")
+    worker_dirs = [os.path.join(ckpt_root, f"w{w}") for w in range(args.workers)]
+
+    env = StreamExecutionEnvironment(parallelism=1)
+    env.set_mesh(mesh)
+    # Aligned-across-hosts barriers: checkpoint k lands after every
+    # worker's k * (ckpt_every_steps * local_batch)-th source record.
+    env.enable_checkpointing(
+        my_ckpt, every_n_records=args.ckpt_every_steps * local_batch
+    )
+
+    losses = []
+
+    def sink(record):
+        losses.append(float(record["loss"]))
+        if (not args.no_failure and args.attempt == 0
+                and args.worker == args.fail_worker
+                and len(losses) >= args.fail_at_step):
+            # Injected TaskManager loss: die mid-round, off a checkpoint
+            # boundary, taking the cohort's collectives down with us.
+            os._exit(1)
+
+    (
+        env.from_collection(records, parallelism=1)
+        .count_window(local_batch)
+        .apply(
+            DPTrainWindowFunction(mdef, optax.adam(1e-2), train_schema=schema,
+                                  global_batch=args.global_batch),
+            name="dp_train",
+        )
+        .sink_to_callable(sink)
+    )
+
+    restored_id = None
+    if args.attempt > 0:
+        restored_id = latest_common_checkpoint(worker_dirs)
+    env.execute(
+        "multihost-dp-train",
+        timeout=600,
+        restore_from=my_ckpt if restored_id is not None else None,
+        restore_checkpoint_id=restored_id,
+    )
+
+    result = {
+        "worker": args.worker,
+        "attempt": args.attempt,
+        "global_devices": topo.global_devices,
+        "num_processes": topo.num_processes,
+        "restored_checkpoint": restored_id,
+        "steps_this_attempt": len(losses),
+        "total_steps": total_steps,
+        "losses": [round(l, 6) for l in losses],
+    }
+    with open(os.path.join(args.work_dir, f"result_w{args.worker}.json"), "w") as f:
+        json.dump(result, f)
+
+    # -- remote record plane: ship the loss stream to worker 0 ------------
+    _aggregate_phase(args, losses)
+    return 0
+
+
+def _aggregate_phase(args, losses) -> None:
+    """Every worker RemoteSinks its per-step losses; worker 0 fans them
+    in (multi-connection RemoteSource) and writes the cohort summary."""
+    import threading
+
+    import numpy as np
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment
+    from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+    from flink_tensorflow_tpu.tensors import TensorValue
+
+    def ship():
+        senv = StreamExecutionEnvironment(parallelism=1)
+        data = [
+            TensorValue({"loss": np.float32(l)},
+                        meta={"worker": args.worker, "step": i})
+            for i, l in enumerate(losses)
+        ]
+        senv.from_collection(data, parallelism=1).add_sink(
+            RemoteSink("127.0.0.1", args.agg_port), name="ship_losses"
+        )
+        senv.execute("ship-losses", timeout=120)
+
+    if args.worker == 0:
+        source = RemoteSource("127.0.0.1", args.agg_port, fan_in=args.workers)
+        aenv = StreamExecutionEnvironment(parallelism=1)
+        received = aenv.from_source(source, name="loss_fanin", parallelism=1).sink_to_list()
+        # Worker 0 ships to itself too — run the sink job on a thread.
+        t = threading.Thread(target=ship, daemon=True)
+        t.start()
+        aenv.execute("aggregate-losses", timeout=120)
+        t.join(timeout=30)
+        by_worker = {}
+        for r in received:
+            by_worker.setdefault(int(r.meta["worker"]), []).append(
+                (int(r.meta["step"]), float(r["loss"]))
+            )
+        summary = {
+            "workers_reporting": sorted(by_worker),
+            "records_received": len(received),
+            "mean_final_loss": round(
+                float(np.mean([sorted(v)[-1][1] for v in by_worker.values()])), 6
+            ),
+        }
+        with open(os.path.join(args.work_dir, "aggregate.json"), "w") as f:
+            json.dump(summary, f)
+    else:
+        ship()
+
+
+# ---------------------------------------------------------------------------
+# parent mode (the JobManager analogue)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_parent(args) -> dict:
+    from flink_tensorflow_tpu.parallel import CohortSupervisor
+
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="multihost_dp_")
+    # Fresh ports per attempt: the dead coordinator's socket may linger.
+    if args.base_port:
+        ports = {a: (args.base_port + a, args.base_port + 500 + a) for a in range(4)}
+    else:
+        ports = {a: (_free_port(), _free_port()) for a in range(4)}
+
+    def command(worker, num_workers, attempt):
+        cport, aport = ports[attempt]
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--worker", str(worker),
+            "--workers", str(num_workers),
+            "--attempt", str(attempt),
+            "--coordinator-port", str(cport),
+            "--agg-port", str(aport),
+            "--devices-per-worker", str(args.devices_per_worker),
+            "--records-per-worker", str(args.records_per_worker),
+            "--global-batch", str(args.global_batch),
+            "--ckpt-every-steps", str(args.ckpt_every_steps),
+            "--fail-worker", str(args.fail_worker),
+            "--fail-at-step", str(args.fail_at_step),
+            "--work-dir", work_dir,
+        ]
+        if args.no_failure:
+            cmd.append("--no-failure")
+        return cmd
+
+    supervisor = CohortSupervisor(
+        command, args.workers, max_restarts=2, attempt_timeout_s=600
+    )
+    t0 = time.time()
+    outcome = supervisor.run()
+
+    results = []
+    for w in range(args.workers):
+        with open(os.path.join(work_dir, f"result_w{w}.json")) as f:
+            results.append(json.load(f))
+    with open(os.path.join(work_dir, "aggregate.json")) as f:
+        aggregate = json.load(f)
+
+    summary = {
+        "job": "multihost_dp_train",
+        "workers": args.workers,
+        "cohort_attempts": outcome.attempts,
+        "wall_s": round(time.time() - t0, 1),
+        "global_devices": results[0]["global_devices"],
+        "restored_checkpoint": results[0]["restored_checkpoint"],
+        "steps_final_attempt": results[0]["steps_this_attempt"],
+        "loss_first": results[0]["losses"][0] if results[0]["losses"] else None,
+        "loss_last": results[0]["losses"][-1] if results[0]["losses"] else None,
+        "losses_agree_across_workers": all(
+            r["losses"] == results[0]["losses"] for r in results
+        ),
+        "aggregate": aggregate,
+        "work_dir": work_dir,
+    }
+    print(json.dumps(summary))
+    return summary
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.global_batch % args.workers:
+        raise SystemExit("global-batch must divide by workers")
+    if args.worker is not None:
+        sys.exit(run_worker(args))
+    return run_parent(args)
+
+
+if __name__ == "__main__":
+    main()

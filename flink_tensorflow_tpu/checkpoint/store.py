@@ -111,9 +111,10 @@ def write_checkpoint(
     return final
 
 
-def latest_checkpoint_id(base_dir: str) -> typing.Optional[int]:
+def checkpoint_ids(base_dir: str) -> typing.List[int]:
+    """All completed checkpoint ids under ``base_dir``, ascending."""
     if not os.path.isdir(base_dir):
-        return None
+        return []
     ids = []
     for name in os.listdir(base_dir):
         if name.startswith("chk-") and not name.endswith(".tmp"):
@@ -121,7 +122,12 @@ def latest_checkpoint_id(base_dir: str) -> typing.Optional[int]:
                 ids.append(int(name[4:]))
             except ValueError:
                 continue
-    return max(ids) if ids else None
+    return sorted(ids)
+
+
+def latest_checkpoint_id(base_dir: str) -> typing.Optional[int]:
+    ids = checkpoint_ids(base_dir)
+    return ids[-1] if ids else None
 
 
 def read_checkpoint(

@@ -22,20 +22,36 @@ if typing.TYPE_CHECKING:
 
 
 class _PendingCheckpoint:
-    def __init__(self, checkpoint_id: int, expected: int):
+    def __init__(self, checkpoint_id: int, expected: int, *, source_initiated: bool = False):
         self.checkpoint_id = checkpoint_id
         self.expected = expected
         self.snapshots: typing.Dict[str, typing.Dict[int, typing.Any]] = {}
         self.acks = 0
         self.done = threading.Event()
         self.failed = False
+        #: Count-based checkpoints have no trigger() caller waiting on
+        #: them — persistence happens on completion, off the ack thread.
+        self.source_initiated = source_initiated
 
 
 class CheckpointCoordinator:
-    """Triggers barriers at sources, collects one snapshot per subtask.
+    """Collects one snapshot per subtask per aligned checkpoint.
 
-    One checkpoint in flight at a time (channel blocking during alignment
-    is per-gate, not per-checkpoint-id).
+    Two trigger modes:
+
+    - ``trigger()`` (timer/manual): the coordinator allocates an id and
+      asks every source to inject a barrier at its CURRENT position.
+      One such checkpoint runs at a time.
+    - **source-initiated** (``begin_source_checkpoint``): with
+      ``CheckpointConfig.every_n_records``, each source injects barrier
+      ``k`` deterministically after its ``k*N``-th record.  Barrier
+      positions are then a pure function of the stream — the property
+      multi-host cohorts need, since each host checkpoints independently
+      and can only restore a checkpoint all hosts cut at the SAME stream
+      position (see parallel/supervisor.latest_common_checkpoint).
+      Several such checkpoints may be in flight when source subtasks run
+      at different speeds; per-gate channel blocking still serializes
+      alignment within each gate.
     """
 
     def __init__(self, executor: "LocalExecutor", checkpoint_dir: typing.Optional[str] = None):
@@ -47,11 +63,16 @@ class CheckpointCoordinator:
         #: is in flight (manual colliding with the periodic timer) queues
         #: behind it instead of failing.
         self._trigger_lock = threading.Lock()
-        self._pending: typing.Optional[_PendingCheckpoint] = None
+        self._pending: typing.Dict[int, _PendingCheckpoint] = {}
         self._completed: typing.List[int] = []
         #: Final snapshots of subtasks that finished (bounded jobs): used to
         #: complete checkpoints racing with job completion.
         self._final_snapshots: typing.Dict[typing.Tuple[str, int], typing.Any] = {}
+        #: Serializes source-initiated checkpoint persistence (one write at
+        #: a time, in completion order) and lets join() drain it so a
+        #: completed checkpoint is durable before the job reports done.
+        self._persist_pool = None
+        self._persist_futures: typing.List[typing.Any] = []
 
     def resume_from(self, checkpoint_id: int) -> None:
         """Continue numbering after a restored checkpoint so new snapshots
@@ -68,6 +89,12 @@ class CheckpointCoordinator:
         timer), the second call waits for the first to drain — within the
         same ``timeout`` budget — and then runs its own checkpoint.
         """
+        if self.executor.checkpoint_every_n:
+            raise RuntimeError(
+                "manual/timer checkpoints are disabled when "
+                "checkpoint.every_n_records is set — barrier positions must "
+                "stay a deterministic function of the stream"
+            )
         deadline = time.monotonic() + timeout
         if not self._trigger_lock.acquire(timeout=timeout):
             raise TimeoutError(
@@ -78,27 +105,31 @@ class CheckpointCoordinator:
         finally:
             self._trigger_lock.release()
 
+    def _seed_finished(self, pending: _PendingCheckpoint) -> None:
+        """Subtasks already finished ack immediately with their final state
+        (caller holds the lock)."""
+        for (task, idx), snap in self._final_snapshots.items():
+            pending.snapshots.setdefault(task, {})[idx] = snap
+            pending.acks += 1
+        if pending.acks >= pending.expected:
+            pending.done.set()
+
     def _trigger_locked(self, timeout: float) -> typing.Dict[str, typing.Dict[int, typing.Any]]:
         with self._lock:
             cid = self._next_id
             self._next_id += 1
             pending = _PendingCheckpoint(cid, self.executor.total_subtasks)
-            self._pending = pending
-            # Subtasks already finished ack immediately with their final state.
-            for (task, idx), snap in self._final_snapshots.items():
-                pending.snapshots.setdefault(task, {})[idx] = snap
-                pending.acks += 1
-            if pending.acks >= pending.expected:
-                pending.done.set()
+            self._pending[cid] = pending
+            self._seed_finished(pending)
         sources = [st for st in self.executor.subtasks if st.t.is_source]
         for st in sources:
             st.request_checkpoint(cid)
         if not pending.done.wait(timeout):
             with self._lock:
-                self._pending = None
+                self._pending.pop(cid, None)
             raise TimeoutError(f"checkpoint {cid} did not complete within {timeout}s")
         with self._lock:
-            self._pending = None
+            self._pending.pop(cid, None)
         if pending.failed:
             raise RuntimeError(f"checkpoint {cid} failed (job cancelled)")
         self._completed.append(cid)
@@ -108,39 +139,109 @@ class CheckpointCoordinator:
             write_checkpoint(self.checkpoint_dir, cid, pending.snapshots)
         return pending.snapshots
 
+    def begin_source_checkpoint(self, checkpoint_id: int) -> bool:
+        """Register a count-based checkpoint (idempotent across the source
+        subtasks that reach the trigger position).  Returns True when the
+        calling source should snapshot+broadcast its barrier, False when
+        the id belongs to an already-completed/restored checkpoint."""
+        with self._lock:
+            if checkpoint_id in self._pending:
+                return True
+            if checkpoint_id < self._next_id:
+                return False  # restored past it, or already completed
+            pending = _PendingCheckpoint(
+                checkpoint_id, self.executor.total_subtasks, source_initiated=True
+            )
+            self._pending[checkpoint_id] = pending
+            self._next_id = max(self._next_id, checkpoint_id + 1)
+            self._seed_finished(pending)
+        return True
+
+    def _complete_async(self, pending: _PendingCheckpoint) -> None:
+        """Finish a source-initiated checkpoint (no trigger() caller):
+        persist off the acking subtask's thread, serialized in completion
+        order.  join()/wait_for_persistence drains the queue so completed
+        checkpoints are durable before the job reports done."""
+        self._completed.append(pending.checkpoint_id)
+        if self.checkpoint_dir is None:
+            return
+
+        def persist():
+            from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
+
+            try:
+                write_checkpoint(self.checkpoint_dir, pending.checkpoint_id,
+                                 pending.snapshots)
+            except Exception:  # pragma: no cover - disk trouble
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "persisting checkpoint %d failed", pending.checkpoint_id,
+                    exc_info=True,
+                )
+
+        with self._lock:
+            if self._persist_pool is None:
+                import concurrent.futures
+
+                self._persist_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="chk-persist"
+                )
+            self._persist_futures.append(self._persist_pool.submit(persist))
+
+    def wait_for_persistence(self, timeout: typing.Optional[float] = 60.0) -> None:
+        """Block until every completed checkpoint has landed on disk."""
+        import concurrent.futures
+
+        with self._lock:
+            futures, self._persist_futures = self._persist_futures, []
+        if futures:
+            concurrent.futures.wait(futures, timeout=timeout)
+
     # -- subtask callbacks -------------------------------------------------
     def ack(self, checkpoint_id: int, task: str, subtask_index: int, snapshot: typing.Any) -> None:
         with self._lock:
-            pending = self._pending
-            if pending is None or pending.checkpoint_id != checkpoint_id:
+            pending = self._pending.get(checkpoint_id)
+            if pending is None:
                 return
             pending.snapshots.setdefault(task, {})[subtask_index] = snapshot
             pending.acks += 1
-            if pending.acks >= pending.expected:
+            finished = pending.acks >= pending.expected
+            if finished:
                 pending.done.set()
+                if pending.source_initiated:
+                    del self._pending[checkpoint_id]
+        if finished and pending.source_initiated and not pending.failed:
+            self._complete_async(pending)
 
     def subtask_finished(self, subtask: "_Subtask") -> None:
         key = (subtask.t.name, subtask.index)
+        completed = []
         with self._lock:
             try:
                 snap = subtask.operator.snapshot()
             except Exception:  # pragma: no cover - state already released
                 snap = None
             self._final_snapshots[key] = snap
-            pending = self._pending
-            if pending is not None and subtask.index not in pending.snapshots.get(
-                subtask.t.name, {}
-            ):
-                pending.snapshots.setdefault(subtask.t.name, {})[subtask.index] = snap
-                pending.acks += 1
-                if pending.acks >= pending.expected:
-                    pending.done.set()
+            for cid, pending in list(self._pending.items()):
+                if subtask.index not in pending.snapshots.get(subtask.t.name, {}):
+                    pending.snapshots.setdefault(subtask.t.name, {})[subtask.index] = snap
+                    pending.acks += 1
+                    if pending.acks >= pending.expected:
+                        pending.done.set()
+                        if pending.source_initiated:
+                            del self._pending[cid]
+                            if not pending.failed:
+                                completed.append(pending)
+        for pending in completed:
+            self._complete_async(pending)
 
     def cancel_pending(self) -> None:
         with self._lock:
-            if self._pending is not None:
-                self._pending.failed = True
-                self._pending.done.set()
+            for pending in self._pending.values():
+                pending.failed = True
+                pending.done.set()
+            self._pending.clear()
 
     @property
     def completed_ids(self) -> typing.List[int]:

@@ -28,6 +28,12 @@ class CheckpointConfig:
     dir: typing.Optional[str] = None
     #: Periodic trigger interval; None means manual triggers only.
     interval_s: typing.Optional[float] = None
+    #: Count-based triggers: each source injects barrier k after its
+    #: k*N-th record — barrier positions become a deterministic function
+    #: of the stream, the consistency contract multi-host cohorts need
+    #: (every process cuts snapshots at identical stream positions).
+    #: Mutually exclusive with interval_s; disables manual triggers.
+    every_n_records: typing.Optional[int] = None
     #: Budget for one aligned checkpoint to drain.
     timeout_s: float = 60.0
 
@@ -37,6 +43,18 @@ class CheckpointConfig:
                 raise ValueError("checkpoint.interval_s requires checkpoint.dir")
             if self.interval_s <= 0:
                 raise ValueError(f"checkpoint.interval_s must be > 0, got {self.interval_s}")
+        if self.every_n_records is not None:
+            if self.dir is None:
+                raise ValueError("checkpoint.every_n_records requires checkpoint.dir")
+            if self.interval_s is not None:
+                raise ValueError(
+                    "checkpoint.every_n_records and interval_s are mutually "
+                    "exclusive (count-based barriers must stay deterministic)"
+                )
+            if self.every_n_records < 1:
+                raise ValueError(
+                    f"checkpoint.every_n_records must be >= 1, got {self.every_n_records}"
+                )
         if self.timeout_s <= 0:
             raise ValueError(f"checkpoint.timeout_s must be > 0, got {self.timeout_s}")
 

@@ -87,14 +87,18 @@ class StreamExecutionEnvironment:
         return self.configure(parallelism=parallelism)
 
     def enable_checkpointing(
-        self, checkpoint_dir: str, interval_s: typing.Optional[float] = None
+        self, checkpoint_dir: str, interval_s: typing.Optional[float] = None,
+        *, every_n_records: typing.Optional[int] = None,
     ) -> "StreamExecutionEnvironment":
         """Persist aligned snapshots under ``checkpoint_dir``; with
         ``interval_s`` they trigger periodically (Flink's checkpoint
-        interval), otherwise only on explicit ``trigger_checkpoint``."""
+        interval), with ``every_n_records`` at deterministic source
+        positions (the multi-host mode — see CheckpointCoordinator),
+        otherwise only on explicit ``trigger_checkpoint``."""
         return self.configure(
             checkpoint=dataclasses.replace(
-                self.config.checkpoint, dir=checkpoint_dir, interval_s=interval_s
+                self.config.checkpoint, dir=checkpoint_dir, interval_s=interval_s,
+                every_n_records=every_n_records,
             )
         )
 
@@ -221,6 +225,7 @@ class StreamExecutionEnvironment:
             job_config=dict(cfg.user_params),
             source_throttle_s=cfg.source_throttle_s,
             checkpoint_dir=cfg.checkpoint.dir,
+            checkpoint_every_n=cfg.checkpoint.every_n_records,
         )
 
     def execute(

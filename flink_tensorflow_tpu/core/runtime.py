@@ -85,6 +85,7 @@ class _Subtask:
         try:
             op.open()
             throttle = self.executor.source_throttle_s
+            every_n = self.executor.checkpoint_every_n
             for value in op.iterate():
                 if self.executor.cancelled.is_set():
                     break
@@ -93,6 +94,15 @@ class _Subtask:
                     self.output.broadcast_element(el.CheckpointBarrier(cid))
                 self.output.emit(value)
                 op.record_emitted()
+                # Count-based barriers: checkpoint k cuts the stream after
+                # this subtask's k*N-th record — a deterministic position,
+                # identical on every host running the same job (the
+                # multi-host consistency contract; see CheckpointCoordinator).
+                if every_n and op.offset % every_n == 0:
+                    cid = op.offset // every_n
+                    if self.executor.coordinator.begin_source_checkpoint(cid):
+                        self._snapshot_and_ack(cid)
+                        self.output.broadcast_element(el.CheckpointBarrier(cid))
                 if throttle:
                     time.sleep(throttle)
             # Serve any barrier requests that raced with the last records.
@@ -192,6 +202,7 @@ class LocalExecutor:
         job_config: typing.Optional[dict] = None,
         source_throttle_s: float = 0.0,
         checkpoint_dir: typing.Optional[str] = None,
+        checkpoint_every_n: typing.Optional[int] = None,
     ):
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
 
@@ -202,6 +213,7 @@ class LocalExecutor:
         self.mesh = mesh
         self.job_config = job_config or {}
         self.source_throttle_s = source_throttle_s
+        self.checkpoint_every_n = checkpoint_every_n
         self.cancelled = threading.Event()
         self._error: typing.Optional[BaseException] = None
         self._error_lock = threading.Lock()
@@ -353,6 +365,11 @@ class LocalExecutor:
             if st.thread.is_alive():
                 self.cancel()
                 raise JobTimeout(f"timeout waiting for subtask {st.scope}")
+        # Completed count-based checkpoints must be durable before the job
+        # reports done (a cohort worker exits right after this returns).
+        self.coordinator.wait_for_persistence(
+            None if deadline is None else max(0.1, deadline - time.monotonic())
+        )
         if self._error is not None:
             raise JobFailure(f"job failed: {self._error!r}") from self._error
 

@@ -213,6 +213,16 @@ class DPTrainWindowFunction(fn.WindowFunction):
     (SURVEY.md §7 hard part 4: "DP training wants one jitted step spanning
     all chips").  The window size is the global batch; it is padded to the
     fixed ``global_batch`` (must divide by the mesh's data axis).
+
+    **Multi-host**: when the mesh spans processes (SURVEY.md §7 step 8),
+    every process runs this same gang operator SPMD-style; each ingests
+    its own stream partition of ``global_batch // process_count`` records
+    per window (size your count_window accordingly) and the global batch
+    array is formed from the process-local rows without cross-host
+    copies.  All processes must fire the same number of windows — feed
+    them equal-length partitions — and checkpoint triggers must land at
+    identical step counts on every process (deterministic, count-based
+    triggers; see examples/multihost_dp_train.py).
     """
 
     def __init__(
@@ -256,6 +266,8 @@ class DPTrainWindowFunction(fn.WindowFunction):
             )
         if ctx.parallelism != 1:
             raise RuntimeError("gang operator must run with parallelism=1")
+        from flink_tensorflow_tpu.parallel.mesh import spans_processes
+
         self.ctx = ctx
         self.mesh = ctx.mesh
         data_size = self.mesh.shape.get("data", 1)
@@ -264,6 +276,14 @@ class DPTrainWindowFunction(fn.WindowFunction):
                 f"global_batch {self.global_batch} must be divisible by the "
                 f"data-axis size {data_size}"
             )
+        n_proc = jax.process_count() if spans_processes(self.mesh) else 1
+        if self.global_batch % n_proc:
+            raise ValueError(
+                f"global_batch {self.global_batch} must be divisible by the "
+                f"process count {n_proc}"
+            )
+        # Each process assembles only its shard of the global batch.
+        self._policy = BucketPolicy(fixed_batch=self.global_batch // n_proc)
         optimizer = self.optimizer or optax.sgd(0.01)
         self.optimizer = optimizer
         self._step_fn = make_dp_train_step(self.model_def, optimizer, self.mesh)

@@ -40,9 +40,26 @@ class RemoteSink(fn.SinkFunction):
         return RemoteSink(self.host, self.port, connect_timeout_s=self.connect_timeout_s)
 
     def open(self, ctx) -> None:
-        self._sock = socket.create_connection(
-            (self.host, self.port), timeout=self.connect_timeout_s
-        )
+        import time
+
+        # Retry refused connections until the deadline: in a cohort the
+        # peer's listener may come up after this job starts (process
+        # startup order is not coordinated).
+        deadline = time.monotonic() + self.connect_timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"RemoteSink could not reach {self.host}:{self.port} "
+                    f"within {self.connect_timeout_s}s"
+                )
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=remaining
+                )
+                break
+            except ConnectionRefusedError:
+                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
@@ -62,22 +79,64 @@ class RemoteSink(fn.SinkFunction):
             self._sock = None
 
 
+def _read_frames(conn) -> typing.Iterator[TensorValue]:
+    """Decode length-prefixed frames off one connection; raises on
+    truncation (EOF mid-frame = peer died mid-send; a silent stop would
+    pass truncation off as a clean close)."""
+    buf = b""
+
+    def read_exact(n: int, *, mid_frame: bool) -> typing.Optional[bytes]:
+        nonlocal buf
+        while len(buf) < n:
+            chunk = conn.recv(1 << 20)
+            if not chunk:
+                if buf or mid_frame:
+                    raise ConnectionError(
+                        "remote peer closed mid-frame (stream truncated)"
+                    )
+                return None
+            buf += chunk
+        out, buf = buf[:n], buf[n:]
+        return out
+
+    while True:
+        head = read_exact(_LEN.size, mid_frame=False)
+        if head is None:
+            return  # clean shutdown between frames
+        (length,) = _LEN.unpack(head)
+        payload = read_exact(length, mid_frame=True)
+        yield decode_record(payload)
+
+
 class RemoteSource(fn.SourceFunction):
-    """Accepts ONE RemoteSink connection and yields its records.
+    """Accepts ``fan_in`` RemoteSink connections and yields their records.
 
     Bind with port=0 to pick a free port; read it from :attr:`port`
-    after construction (the listener opens eagerly so the peer can
-    connect before the job starts).
+    after construction (the listener opens eagerly so peers can connect
+    before the job starts).
+
+    ``fan_in=1`` (default) reads a single peer inline.  ``fan_in>1`` is
+    the multi-producer merge — N upstream processes each connect a
+    RemoteSink and records interleave in arrival order (no ordering
+    across peers, exactly like Flink's network shuffle fan-in); one
+    reader thread per connection feeds a bounded queue (backpressure to
+    the sockets), and the source finishes when ALL peers have closed
+    cleanly.  A truncated peer stream fails the source loudly.
     """
 
     def __init__(self, bind: str = "0.0.0.0", port: int = 0,
-                 *, accept_timeout_s: float = 60.0):
+                 *, fan_in: int = 1, accept_timeout_s: float = 60.0,
+                 queue_capacity: int = 1024):
+        if fan_in < 1:
+            raise ValueError(f"fan_in must be >= 1, got {fan_in}")
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind, port))
-        self._listener.listen(1)
+        self._listener.listen(fan_in)
         self.port = self._listener.getsockname()[1]
+        self.fan_in = fan_in
         self.accept_timeout_s = accept_timeout_s
+        self.queue_capacity = queue_capacity
 
     def clone(self):
         return self  # the listener is the identity; parallelism must be 1
@@ -85,43 +144,79 @@ class RemoteSource(fn.SourceFunction):
     def open(self, ctx) -> None:
         if ctx.parallelism != 1:
             raise RuntimeError(
-                "RemoteSource accepts exactly one connection — run it with "
-                f"parallelism=1 (got {ctx.parallelism})"
+                "RemoteSource owns one listener — run it with "
+                f"parallelism=1 (got {ctx.parallelism}); scale ingest by "
+                "raising fan_in instead"
             )
 
     def run(self) -> typing.Iterator[typing.Any]:
         self._listener.settimeout(self.accept_timeout_s)
-        conn, _ = self._listener.accept()
-        conn.settimeout(None)
+        if self.fan_in == 1:
+            conn, _ = self._listener.accept()
+            conn.settimeout(None)
+            try:
+                yield from _read_frames(conn)
+            finally:
+                conn.close()
+            return
+
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_capacity)
+        stop = threading.Event()
+        _EOS, _ERR = object(), object()
+
+        def put(item) -> bool:
+            # Bounded-queue put that aborts on shutdown: a reader must
+            # never stay blocked on a full queue nobody drains anymore
+            # (error/early-exit path).
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader(conn):
+            try:
+                for record in _read_frames(conn):
+                    if not put(record):
+                        return
+                put(_EOS)
+            except BaseException as exc:  # noqa: BLE001 — relayed to the source loop
+                put((_ERR, exc))
+            finally:
+                conn.close()
+
+        threads, conns = [], []
         try:
-            buf = b""
-
-            def read_exact(n: int, *, mid_frame: bool) -> typing.Optional[bytes]:
-                nonlocal buf
-                while len(buf) < n:
-                    chunk = conn.recv(1 << 20)
-                    if not chunk:
-                        if buf or mid_frame:
-                            # EOF inside a frame = peer died mid-send; a
-                            # silent stop would pass truncation off as a
-                            # clean close.
-                            raise ConnectionError(
-                                "remote peer closed mid-frame (stream truncated)"
-                            )
-                        return None
-                    buf += chunk
-                out, buf = buf[:n], buf[n:]
-                return out
-
-            while True:
-                head = read_exact(_LEN.size, mid_frame=False)
-                if head is None:
-                    return  # clean shutdown between frames
-                (length,) = _LEN.unpack(head)
-                payload = read_exact(length, mid_frame=True)
-                yield decode_record(payload)
+            for _ in range(self.fan_in):
+                conn, _ = self._listener.accept()
+                conn.settimeout(None)
+                conns.append(conn)
+                t = threading.Thread(target=reader, args=(conn,), daemon=True)
+                t.start()
+                threads.append(t)
+            closed = 0
+            while closed < self.fan_in:
+                item = q.get()
+                if item is _EOS:
+                    closed += 1
+                elif isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                    raise item[1]
+                else:
+                    yield item
         finally:
-            conn.close()
+            stop.set()
+            for conn in conns:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            for t in threads:
+                t.join(timeout=2.0)
 
     def close(self) -> None:
         self._listener.close()

@@ -24,6 +24,13 @@ from flink_tensorflow_tpu.parallel.mesh import (
     replicate,
     replicated,
     shard_batch,
+    spans_processes,
+)
+from flink_tensorflow_tpu.parallel.supervisor import (
+    CohortFailed,
+    CohortOutcome,
+    CohortSupervisor,
+    latest_common_checkpoint,
 )
 from flink_tensorflow_tpu.parallel.ring_attention import (
     full_attention,
@@ -38,9 +45,13 @@ __all__ = [
     "MeshSpec",
     "PIPE_AXIS",
     "SEQ_AXIS",
+    "CohortFailed",
+    "CohortOutcome",
+    "CohortSupervisor",
     "batch_sharding",
     "full_attention",
     "init_train_state",
+    "latest_common_checkpoint",
     "make_dp_train_step",
     "make_mesh",
     "make_train_step",
@@ -50,4 +61,5 @@ __all__ = [
     "ring_attention",
     "ring_attention_sharded",
     "shard_batch",
+    "spans_processes",
 ]

@@ -118,15 +118,54 @@ def replicated(mesh):
     return named_sharding(mesh)
 
 
+def spans_processes(mesh) -> bool:
+    """True when the mesh's devices live in more than one process — the
+    multi-host case where each process holds only its local batch shard."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
 def shard_batch(mesh, pytree):
-    """Place a host batch pytree on the mesh, dim 0 split over ``data``."""
+    """Place a host batch pytree on the mesh, dim 0 split over ``data``.
+
+    Single process: ``pytree`` is the global batch, one transfer.
+    Multi-process mesh: ``pytree`` is THIS PROCESS's shard of the global
+    batch (each host ingests its own stream partition — the reference's
+    per-TaskManager ingestion, SURVEY.md §3.5); the global jax.Array is
+    assembled from the process-local rows without any cross-host copy.
+    """
     import jax
 
-    return jax.device_put(pytree, batch_sharding(mesh))
+    sharding = batch_sharding(mesh)
+    if spans_processes(mesh):
+        import numpy as np
+
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+            pytree,
+        )
+    return jax.device_put(pytree, sharding)
 
 
 def replicate(mesh, pytree):
-    """Replicate params/state across the whole mesh (pure-DP placement)."""
+    """Replicate params/state across the whole mesh (pure-DP placement).
+
+    Multi-process meshes assemble the global replicated array from each
+    process's (identical) host copy; typed PRNG keys are unwrapped to
+    their raw data for the placement and rewrapped after.
+    """
     import jax
 
-    return jax.device_put(pytree, replicated(mesh))
+    sharding = replicated(mesh)
+    if not spans_processes(mesh):
+        return jax.device_put(pytree, sharding)
+    import numpy as np
+
+    def place(x):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            data = jax.make_array_from_process_local_data(
+                sharding, np.asarray(jax.random.key_data(x))
+            )
+            return jax.random.wrap_key_data(data, impl=jax.random.key_impl(x))
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree.map(place, pytree)

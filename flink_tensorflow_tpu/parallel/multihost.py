@@ -45,6 +45,10 @@ def initialize(
     Arguments default from the standard env vars the launcher sets
     (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``);
     TPU pod slices auto-discover all three from the TPU metadata server.
+
+    On the CPU backend (tests / MiniCluster-style local cohorts,
+    SURVEY.md §4) cross-process collectives need an explicit transport —
+    gloo is selected automatically; TPU cohorts use ICI/DCN natively.
     """
     import jax
 
@@ -54,6 +58,15 @@ def initialize(
 
     already = jax.distributed.is_initialized()
     if not already and (coordinator_address is not None or num_processes not in (None, 1)):
+        # The platform may be pinned via env var OR jax.config (the axon
+        # plugin workaround uses the latter); honor both.
+        platforms = (
+            getattr(jax.config, "jax_platforms", None)
+            or os.environ.get("JAX_PLATFORMS", "")
+            or ""
+        )
+        if (num_processes or 1) > 1 and "cpu" in platforms.split(","):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -1,0 +1,170 @@
+"""Cohort supervisor — multi-host failure detection + restart-from-checkpoint.
+
+The reference inherits failure detection from Flink: JobManager<->
+TaskManager heartbeats, and on a TaskManager loss the job's region is
+restarted from the last completed snapshot (SURVEY.md §5 "Failure
+detection / elastic recovery").  The TPU-native divergence documented
+there: an XLA mesh cannot shrink live, so recovery is *cohort* recovery —
+on any worker loss the supervisor kills the survivors (their next
+collective would hang against the dead peer), re-spawns the whole cohort,
+and the workers re-form the mesh and restore from their last COMMON
+checkpoint (see :func:`latest_common_checkpoint`).
+
+The supervisor is deliberately a process-level component (the reference's
+JobManager is a separate JVM): workers stay ordinary job binaries with no
+supervision code in them, and a supervisor crash leaves workers killable
+by the next supervisor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import subprocess
+import time
+import typing
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class CohortOutcome:
+    """Result of supervising one cohort to completion."""
+
+    attempts: int  # total spawn rounds used (1 = no failures)
+    returncode: int  # 0 on success
+
+
+class CohortFailed(RuntimeError):
+    def __init__(self, attempts: int, last_rc: int):
+        super().__init__(
+            f"cohort failed after {attempts} attempt(s); last worker rc={last_rc}"
+        )
+        self.attempts = attempts
+        self.last_rc = last_rc
+
+
+class CohortSupervisor:
+    """Spawns and supervises a cohort of worker processes.
+
+    ``command(worker_id, num_workers, attempt)`` returns the argv for one
+    worker; ``env(worker_id, num_workers, attempt)`` (optional) returns
+    extra environment variables.  The attempt number lets the command
+    builder pick a fresh coordinator port per round (a dead coordinator
+    socket can linger in TIME_WAIT) and lets workers decide to restore.
+
+    Failure policy: the FIRST nonzero worker exit fails the whole attempt
+    — the survivors are sent SIGTERM (SIGKILL after ``kill_grace_s``) and
+    the cohort is re-spawned, up to ``max_restarts`` times.  Workers are
+    responsible for restoring their state from the latest common
+    checkpoint on re-spawn (restart-from-checkpoint, not live elasticity).
+    """
+
+    def __init__(
+        self,
+        command: typing.Callable[[int, int, int], typing.Sequence[str]],
+        num_workers: int,
+        *,
+        env: typing.Optional[typing.Callable[[int, int, int], typing.Mapping[str, str]]] = None,
+        max_restarts: int = 2,
+        poll_s: float = 0.1,
+        kill_grace_s: float = 5.0,
+        attempt_timeout_s: typing.Optional[float] = None,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.command = command
+        self.num_workers = num_workers
+        self.env = env
+        self.max_restarts = max_restarts
+        self.poll_s = poll_s
+        self.kill_grace_s = kill_grace_s
+        self.attempt_timeout_s = attempt_timeout_s
+
+    # -- one attempt -------------------------------------------------------
+    def _spawn(self, attempt: int) -> typing.List[subprocess.Popen]:
+        procs = []
+        for w in range(self.num_workers):
+            env = dict(os.environ)
+            if self.env is not None:
+                env.update(self.env(w, self.num_workers, attempt))
+            procs.append(
+                subprocess.Popen(
+                    list(self.command(w, self.num_workers, attempt)), env=env
+                )
+            )
+            logger.info("attempt %d: spawned worker %d (pid %d)", attempt, w,
+                        procs[-1].pid)
+        return procs
+
+    def _kill_all(self, procs: typing.List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + self.kill_grace_s
+        for p in procs:
+            if p.poll() is None:
+                remaining = deadline - time.monotonic()
+                try:
+                    p.wait(timeout=max(0.0, remaining))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    def _run_attempt(self, attempt: int) -> int:
+        """Returns 0 on cohort success, else the failing worker's rc."""
+        procs = self._spawn(attempt)
+        deadline = (
+            time.monotonic() + self.attempt_timeout_s
+            if self.attempt_timeout_s is not None else None
+        )
+        try:
+            while True:
+                states = [p.poll() for p in procs]
+                failed = [rc for rc in states if rc is not None and rc != 0]
+                if failed:
+                    logger.warning(
+                        "attempt %d: worker failed rc=%s — killing cohort",
+                        attempt, failed[0],
+                    )
+                    return failed[0]
+                if all(rc == 0 for rc in states):
+                    return 0
+                if deadline is not None and time.monotonic() > deadline:
+                    logger.warning("attempt %d: timed out — killing cohort", attempt)
+                    return -1
+                time.sleep(self.poll_s)
+        finally:
+            self._kill_all(procs)
+
+    # -- public ------------------------------------------------------------
+    def run(self) -> CohortOutcome:
+        last_rc = -1
+        for attempt in range(self.max_restarts + 1):
+            rc = self._run_attempt(attempt)
+            if rc == 0:
+                return CohortOutcome(attempts=attempt + 1, returncode=0)
+            last_rc = rc
+        raise CohortFailed(self.max_restarts + 1, last_rc)
+
+
+def latest_common_checkpoint(
+    worker_dirs: typing.Sequence[str],
+) -> typing.Optional[int]:
+    """Highest checkpoint id COMPLETED by every worker, or None.
+
+    Per-process checkpoints are only globally consistent at trigger
+    points all processes reached (deterministic count-based triggers —
+    see DPTrainWindowFunction's multi-host contract); a worker that died
+    mid-round may be one checkpoint behind its peers, so restoring the
+    *latest common* id is the cohort-consistent choice.
+    """
+    from flink_tensorflow_tpu.checkpoint.store import checkpoint_ids
+
+    common: typing.Optional[set] = None
+    for d in worker_dirs:
+        ids = set(checkpoint_ids(d))
+        common = ids if common is None else (common & ids)
+    return max(common) if common else None
