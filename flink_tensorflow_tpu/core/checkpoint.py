@@ -189,14 +189,24 @@ class CheckpointCoordinator:
                 )
             self._persist_futures.append(self._persist_pool.submit(persist))
 
-    def wait_for_persistence(self, timeout: typing.Optional[float] = 60.0) -> None:
-        """Block until every completed checkpoint has landed on disk."""
+    def wait_for_persistence(self, timeout: typing.Optional[float] = 60.0) -> int:
+        """Block until every completed checkpoint has landed on disk.
+
+        Returns the number of writes STILL in flight after ``timeout``
+        (0 = fully durable); unfinished futures stay queued so a later
+        call can drain them — they are never silently dropped."""
         import concurrent.futures
 
         with self._lock:
-            futures, self._persist_futures = self._persist_futures, []
-        if futures:
-            concurrent.futures.wait(futures, timeout=timeout)
+            futures = list(self._persist_futures)
+        if not futures:
+            return 0
+        _, not_done = concurrent.futures.wait(futures, timeout=timeout)
+        with self._lock:
+            self._persist_futures = [
+                f for f in self._persist_futures if f in not_done
+            ]
+        return len(not_done)
 
     # -- subtask callbacks -------------------------------------------------
     def ack(self, checkpoint_id: int, task: str, subtask_index: int, snapshot: typing.Any) -> None:

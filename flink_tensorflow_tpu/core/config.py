@@ -70,6 +70,10 @@ class JobConfig:
 
     #: Default operator parallelism (Flink's env-level parallelism).
     parallelism: int = 1
+    #: Key-group count (Flink's maxParallelism): the upper bound on keyed
+    #: parallelism, fixed for the job's lifetime so keyed state can be
+    #: redistributed when a restart changes parallelism.
+    max_parallelism: int = 128
     #: Bounded capacity of inter-subtask channels (records).
     channel_capacity: int = 1024
     #: Sleep between source emissions — test/backpressure pacing.
@@ -86,6 +90,11 @@ class JobConfig:
     def validate(self) -> "JobConfig":
         if self.parallelism < 1:
             raise ValueError(f"parallelism must be >= 1, got {self.parallelism}")
+        if self.max_parallelism < self.parallelism:
+            raise ValueError(
+                f"max_parallelism {self.max_parallelism} must be >= "
+                f"parallelism {self.parallelism}"
+            )
         if self.channel_capacity < 1:
             raise ValueError(
                 f"channel_capacity must be >= 1, got {self.channel_capacity}"

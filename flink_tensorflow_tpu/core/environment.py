@@ -226,6 +226,7 @@ class StreamExecutionEnvironment:
             source_throttle_s=cfg.source_throttle_s,
             checkpoint_dir=cfg.checkpoint.dir,
             checkpoint_every_n=cfg.checkpoint.every_n_records,
+            max_parallelism=cfg.max_parallelism,
         )
 
     def execute(

@@ -156,3 +156,26 @@ class EventTimeWindowOperator(_FunctionOperator):
 
         self._watermark = state["watermark"]
         self._buffers = restore_buffers(state["buffers"])
+
+    def _rescale_operator_state(self, states, mine):
+        from flink_tensorflow_tpu.core.operators import StateNotRescalable
+
+        buffers = {}
+        # Watermark is per-subtask; the min across old subtasks is the
+        # safe (conservative) restore value on every new subtask.
+        watermark = -math.inf
+        marks = [s["watermark"] for s in states if s]
+        if marks:
+            watermark = min(marks)
+        for s in states:
+            if not s:
+                continue
+            for (key, start), payload in s["buffers"].items():
+                if key == self.GLOBAL_KEY:
+                    raise StateNotRescalable(
+                        f"operator {self.name!r}: non-keyed time-window "
+                        "buffers are per-subtask"
+                    )
+                if mine(key):
+                    buffers[(key, start)] = payload
+        return {"watermark": watermark, "buffers": buffers}

@@ -49,6 +49,14 @@ class RichFunction(Function):
     def restore_state(self, state: typing.Any) -> None:  # noqa: B027
         """Restore from a snapshot produced by :meth:`snapshot_state`."""
 
+    # Optional additional hook — NOT defined here so its absence means
+    # "not rescalable":
+    #   rescale_state(states: list, mine: Callable[[key], bool]) -> Any
+    # Functions whose snapshot_state payload is key-addressable implement
+    # it to support restoring with a different parallelism: merge the old
+    # subtasks' states, keeping only entries whose key satisfies mine()
+    # (see OnlineTrainFunction.rescale_state).
+
 
 class MapFunction(RichFunction, abc.ABC):
     @abc.abstractmethod

@@ -49,6 +49,13 @@ class Output:
         return bool(self._edges)
 
 
+class StateNotRescalable(RuntimeError):
+    """Raised when a restore changes an operator's parallelism but its
+    snapshot holds per-subtask state that cannot be redistributed by
+    key (source offsets, subtask-scoped train state, non-keyed window
+    buffers).  Keep that operator's parallelism fixed across restarts."""
+
+
 class Operator:
     """Base runtime operator."""
 
@@ -113,6 +120,60 @@ class Operator:
     def _operator_restore(self, state: typing.Any) -> None:
         pass
 
+    # -- rescaling (restore with a different parallelism) -----------------
+    def rescale(
+        self,
+        old: typing.Dict[int, typing.Any],
+        index: int,
+        parallelism: int,
+        max_parallelism: int,
+    ) -> typing.Dict[str, typing.Any]:
+        """Build THIS subtask's snapshot from all old subtasks' snapshots.
+
+        Keyed state redistributes by key group (the routing the
+        HashPartitioner uses, so state lands where records will);
+        function/operator state delegates to the per-operator hooks,
+        which raise :class:`StateNotRescalable` for state that is
+        inherently per-subtask.
+        """
+        from flink_tensorflow_tpu.core.partitioning import subtask_for_key
+
+        def mine(key) -> bool:
+            return subtask_for_key(key, parallelism, max_parallelism) == index
+
+        snaps = [s for s in old.values() if s is not None]
+        keyed: typing.Dict[str, typing.Dict[typing.Any, typing.Any]] = {}
+        for snap in snaps:
+            for name, table in snap["keyed"].items():
+                for key, value in table.items():
+                    if mine(key):
+                        keyed.setdefault(name, {})[key] = value
+        return {
+            "keyed": keyed,
+            "function": self._rescale_function_state(
+                [s["function"] for s in snaps], mine
+            ),
+            "operator": self._rescale_operator_state(
+                [s["operator"] for s in snaps], mine
+            ),
+        }
+
+    def _rescale_function_state(self, states: typing.List[typing.Any], mine) -> typing.Any:
+        if any(s is not None for s in states):
+            raise StateNotRescalable(
+                f"operator {self.name!r}: function state is per-subtask and "
+                "cannot be redistributed — restore with the original parallelism"
+            )
+        return None
+
+    def _rescale_operator_state(self, states: typing.List[typing.Any], mine) -> typing.Any:
+        if any(s is not None for s in states):
+            raise StateNotRescalable(
+                f"operator {self.name!r}: operator state is per-subtask and "
+                "cannot be redistributed — restore with the original parallelism"
+            )
+        return None
+
 
 class _FunctionOperator(Operator):
     """Operator wrapping one rich user function."""
@@ -137,6 +198,18 @@ class _FunctionOperator(Operator):
     def _function_restore(self, state):
         if state is not None and isinstance(self.function, fn.RichFunction):
             self.function.restore_state(state)
+
+    def _rescale_function_state(self, states, mine):
+        if all(s is None for s in states):
+            return None
+        hook = getattr(self.function, "rescale_state", None)
+        if hook is None:
+            raise StateNotRescalable(
+                f"operator {self.name!r}: {type(self.function).__name__} "
+                "snapshots per-subtask state and defines no rescale_state "
+                "hook — restore with the original parallelism"
+            )
+        return hook(states, mine)
 
 
 class MapOperator(_FunctionOperator):
@@ -208,6 +281,17 @@ class ProcessOperator(_FunctionOperator):
 
     def _operator_restore(self, state):
         self._timers = {tuple(t): None for t in state["timers"]}
+
+    def _rescale_operator_state(self, states, mine):
+        timers = []
+        for s in states:
+            if s:
+                timers.extend(tuple(t) for t in s["timers"])
+        if timers and self.key_selector is None:
+            raise StateNotRescalable(
+                f"operator {self.name!r}: non-keyed timers are per-subtask"
+            )
+        return {"timers": [t for t in timers if mine(t[0])]}
 
 
 class WindowOperator(_FunctionOperator):
@@ -316,6 +400,24 @@ class WindowOperator(_FunctionOperator):
         self._buffers = restore_buffers(state["buffers"])
         self._window_seq = dict(state["seq"])
 
+    def _rescale_operator_state(self, states, mine):
+        buffers, seq = {}, {}
+        for s in states:
+            if not s:
+                continue
+            for key, payload in s["buffers"].items():
+                if key == self.GLOBAL_KEY:
+                    raise StateNotRescalable(
+                        f"operator {self.name!r}: non-keyed window buffers are "
+                        "per-subtask — restore with the original parallelism"
+                    )
+                if mine(key):
+                    buffers[key] = payload
+            for key, n in s["seq"].items():
+                if key != self.GLOBAL_KEY and mine(key):
+                    seq[key] = max(seq.get(key, 0), n)
+        return {"buffers": buffers, "seq": seq}
+
 
 class SinkOperator(_FunctionOperator):
     def process_record(self, record):
@@ -366,3 +468,11 @@ class SourceOperator(_FunctionOperator):
 
     def _operator_restore(self, state):
         self._restored_offset = state["offset"]
+
+    def rescale(self, old, index, parallelism, max_parallelism):
+        raise StateNotRescalable(
+            f"source {self.name!r}: offsets are bound to the source's record "
+            "partitioning (subtask i emits every P-th record) — changing "
+            "source parallelism invalidates them; keep source parallelism "
+            "fixed and rescale the keyed operators downstream"
+        )

@@ -203,6 +203,7 @@ class LocalExecutor:
         source_throttle_s: float = 0.0,
         checkpoint_dir: typing.Optional[str] = None,
         checkpoint_every_n: typing.Optional[int] = None,
+        max_parallelism: int = 128,
     ):
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
 
@@ -214,6 +215,7 @@ class LocalExecutor:
         self.job_config = job_config or {}
         self.source_throttle_s = source_throttle_s
         self.checkpoint_every_n = checkpoint_every_n
+        self.max_parallelism = max_parallelism
         self.cancelled = threading.Event()
         self._error: typing.Optional[BaseException] = None
         self._error_lock = threading.Lock()
@@ -232,6 +234,15 @@ class LocalExecutor:
         gates: typing.Dict[typing.Tuple[int, int], InputGate] = {}
 
         order = self.graph.topological_order()
+
+        for t in order:
+            if t.parallelism > self.max_parallelism:
+                raise ValueError(
+                    f"operator {t.name!r} parallelism {t.parallelism} exceeds "
+                    f"max_parallelism {self.max_parallelism} — key groups "
+                    "would starve the subtasks above the bound; raise "
+                    "JobConfig.max_parallelism"
+                )
 
         # Pass 1: channel layout per downstream transformation.
         # Forward edges contribute 1 channel per gate; others contribute
@@ -318,13 +329,29 @@ class LocalExecutor:
         if from_checkpoint_id is not None:
             # New checkpoints must never overwrite the restore point.
             self.coordinator.resume_from(from_checkpoint_id)
+        by_task: typing.Dict[str, typing.List[_Subtask]] = {}
         for st in self.subtasks:
-            task_snaps = snapshots.get(st.t.name)
+            by_task.setdefault(st.t.name, []).append(st)
+        for task, sts in by_task.items():
+            task_snaps = snapshots.get(task)
             if task_snaps is None:
                 continue
-            snap = task_snaps.get(st.index)
-            if snap is not None:
-                st.operator.restore(snap)
+            old_parallelism = len(task_snaps)
+            if old_parallelism == len(sts):
+                for st in sts:
+                    snap = task_snaps.get(st.index)
+                    if snap is not None:
+                        st.operator.restore(snap)
+            else:
+                # Parallelism changed across the restart: redistribute by
+                # key group (Flink's rescaling semantics; keyed state only
+                # — per-subtask state raises StateNotRescalable).
+                for st in sts:
+                    st.operator.restore(
+                        st.operator.rescale(
+                            task_snaps, st.index, len(sts), self.max_parallelism
+                        )
+                    )
 
     # --- execution --------------------------------------------------------
     def start(self) -> None:
@@ -367,9 +394,14 @@ class LocalExecutor:
                 raise JobTimeout(f"timeout waiting for subtask {st.scope}")
         # Completed count-based checkpoints must be durable before the job
         # reports done (a cohort worker exits right after this returns).
-        self.coordinator.wait_for_persistence(
+        in_flight = self.coordinator.wait_for_persistence(
             None if deadline is None else max(0.1, deadline - time.monotonic())
         )
+        if in_flight:
+            raise JobTimeout(
+                f"{in_flight} checkpoint persist write(s) did not drain — "
+                "completed checkpoints are not yet durable"
+            )
         if self._error is not None:
             raise JobFailure(f"job failed: {self._error!r}") from self._error
 

@@ -222,7 +222,10 @@ class KeyedStream:
         self.key_selector = key_selector
 
     def _edge(self) -> Edge:
-        return Edge(self.transformation, HashPartitioner(self.key_selector))
+        return Edge(
+            self.transformation,
+            HashPartitioner(self.key_selector, self.env.config.max_parallelism),
+        )
 
     def process(self, f: fn.ProcessFunction, *, name="keyed_process", parallelism=None) -> DataStream:
         parallelism = parallelism or self.env.default_parallelism

@@ -199,6 +199,27 @@ class OnlineTrainFunction(fn.ProcessFunction):
         self._state = snap["state"]
         self._buffers = {k: list(v) for k, v in snap["buffers"].items()}
 
+    def rescale_state(self, states, mine):
+        """Restore with changed parallelism: per-key mini-batch buffers
+        redistribute by key group; a subtask-scoped TrainState cannot
+        (every subtask owns an independent model replica)."""
+        from flink_tensorflow_tpu.core.operators import StateNotRescalable
+
+        if any(s and s.get("state") is not None for s in states):
+            raise StateNotRescalable(
+                "OnlineTrainFunction(scope='subtask') keeps one model per "
+                "subtask — rescaling would drop or duplicate replicas; use "
+                "scope='key' or keep the operator's parallelism fixed"
+            )
+        buffers: typing.Dict[typing.Any, list] = {}
+        for s in states:
+            if not s:
+                continue
+            for key, buf in s["buffers"].items():
+                if mine(key):
+                    buffers.setdefault(key, []).extend(buf)
+        return {"state": None, "buffers": buffers}
+
     def current_params(self, key=None):
         """Latest variables (for export via models.save_bundle)."""
         if self.scope == "key":

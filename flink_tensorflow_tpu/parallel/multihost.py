@@ -47,8 +47,13 @@ def initialize(
     TPU pod slices auto-discover all three from the TPU metadata server.
 
     On the CPU backend (tests / MiniCluster-style local cohorts,
-    SURVEY.md §4) cross-process collectives need an explicit transport —
-    gloo is selected automatically; TPU cohorts use ICI/DCN natively.
+    SURVEY.md §4) cross-process collectives need an explicit transport:
+    gloo is selected automatically **when the platform is pinned to CPU**
+    (``JAX_PLATFORMS=cpu`` or ``jax.config.update("jax_platforms", "cpu")``
+    — use ``utils.platform.force_cpu()``).  When jax is left to
+    auto-detect, the backend cannot be known before ``jax.distributed``
+    initializes, so no transport is forced — pin the platform explicitly
+    for local cohorts.  TPU cohorts use ICI/DCN natively.
     """
     import jax
 

@@ -86,17 +86,23 @@ class CohortSupervisor:
     # -- one attempt -------------------------------------------------------
     def _spawn(self, attempt: int) -> typing.List[subprocess.Popen]:
         procs = []
-        for w in range(self.num_workers):
-            env = dict(os.environ)
-            if self.env is not None:
-                env.update(self.env(w, self.num_workers, attempt))
-            procs.append(
-                subprocess.Popen(
-                    list(self.command(w, self.num_workers, attempt)), env=env
+        try:
+            for w in range(self.num_workers):
+                env = dict(os.environ)
+                if self.env is not None:
+                    env.update(self.env(w, self.num_workers, attempt))
+                procs.append(
+                    subprocess.Popen(
+                        list(self.command(w, self.num_workers, attempt)), env=env
+                    )
                 )
-            )
-            logger.info("attempt %d: spawned worker %d (pid %d)", attempt, w,
-                        procs[-1].pid)
+                logger.info("attempt %d: spawned worker %d (pid %d)", attempt, w,
+                            procs[-1].pid)
+        except BaseException:
+            # A failed spawn must not orphan the workers already started —
+            # they would block forever waiting for the full cohort.
+            self._kill_all(procs)
+            raise
         return procs
 
     def _kill_all(self, procs: typing.List[subprocess.Popen]) -> None:

@@ -1,0 +1,203 @@
+"""Checkpoint rescaling — keyed state redistributes across parallelism.
+
+VERDICT r1 missing #4: restore mapped snapshots by (task, subtask_index),
+so changing parallelism silently dropped/misassigned keyed state.  Flink
+(whose runtime the reference inherits, SURVEY.md §1 L1) redistributes key
+groups; these tests pin the same semantics here.
+"""
+
+import time
+
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.operators import StateNotRescalable
+from flink_tensorflow_tpu.core.partitioning import (
+    DEFAULT_MAX_PARALLELISM,
+    key_group,
+    subtask_for_key,
+    subtask_for_key_group,
+)
+from flink_tensorflow_tpu.core.state import StateDescriptor
+
+
+class TestKeyGroups:
+    def test_groups_partition_contiguously(self):
+        maxp = 128
+        for p in (1, 2, 3, 7, 128):
+            owners = [subtask_for_key_group(g, p, maxp) for g in range(maxp)]
+            assert set(owners) <= set(range(p))
+            assert owners == sorted(owners)  # contiguous ranges
+            assert set(owners) == set(range(p))  # every subtask owns some
+
+    def test_routing_agrees_with_state_assignment(self):
+        # The HashPartitioner and the rescale path must use the same
+        # key -> subtask mapping, else state lands where records don't.
+        from flink_tensorflow_tpu.core.partitioning import HashPartitioner
+
+        part = HashPartitioner(lambda v: v, DEFAULT_MAX_PARALLELISM)
+        for p in (1, 2, 3, 5):
+            for key in ["a", "b", 7, 42, (1, "x")]:
+                assert part.select(key, p) == (
+                    subtask_for_key(key, p, DEFAULT_MAX_PARALLELISM),
+                )
+
+    def test_group_stable_across_processes(self):
+        # FNV hash, not PYTHONHASHSEED-dependent builtin hash.
+        assert key_group("user-17", 128) == key_group("user-17", 128)
+        assert key_group(17, 128) == 17 % 128
+
+
+class _KeyedSum(fn.ProcessFunction):
+    def open(self, ctx):
+        self._desc = StateDescriptor("sum")
+
+    def process_element(self, value, ctx, out):
+        state = ctx.state(self._desc)
+        total = (state.value() or 0) + value["amount"]
+        state.update(total)
+        out.collect({"key": ctx.current_key, "sum": total})
+
+
+def _build(env, records, parallelism):
+    out = (
+        env.from_collection(records, parallelism=1)
+        .key_by(lambda r: r["key"])
+        .process(_KeyedSum(), name="keyed_sum", parallelism=parallelism)
+        .sink_to_list()
+    )
+    return out
+
+
+def _records(n, keys=10):
+    return [{"key": f"k{i % keys}", "amount": i} for i in range(n)]
+
+
+def _expected_sums(records):
+    sums = {}
+    for r in records:
+        sums[r["key"]] = sums.get(r["key"], 0) + r["amount"]
+    return sums
+
+
+class TestRescaleRestore:
+    @pytest.mark.parametrize("old_p,new_p", [(2, 3), (3, 1), (1, 4), (4, 2)])
+    def test_keyed_state_redistributes(self, tmp_path, old_p, new_p):
+        records = _records(300)
+        d = str(tmp_path / "chk")
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d)
+        env.source_throttle_s = 0.002
+        _build(env, records, old_p)
+        h = env.execute_async("rescale")
+        time.sleep(0.2)
+        h.trigger_checkpoint()
+        h.cancel()
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        env2.enable_checkpointing(d)
+        out2 = _build(env2, records, new_p)
+        env2.execute("rescale", restore_from=d, timeout=120)
+
+        # Per-key final sums equal the uninterrupted run: state followed
+        # its keys to the new subtasks, replayed records found it there.
+        finals = {}
+        for r in out2:
+            finals[r["key"]] = max(finals.get(r["key"], 0), r["sum"])
+        assert finals == _expected_sums(records)
+
+    def test_source_rescale_raises(self, tmp_path):
+        records = _records(200)
+        d = str(tmp_path / "chk")
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d)
+        env.source_throttle_s = 0.002
+        (
+            env.from_collection(records, parallelism=2)
+            .key_by(lambda r: r["key"])
+            .process(_KeyedSum(), name="keyed_sum", parallelism=2)
+            .sink_to_list()
+        )
+        h = env.execute_async("src")
+        time.sleep(0.2)
+        h.trigger_checkpoint()
+        h.cancel()
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        env2.enable_checkpointing(d)
+        (
+            env2.from_collection(records, parallelism=4)  # changed!
+            .key_by(lambda r: r["key"])
+            .process(_KeyedSum(), name="keyed_sum", parallelism=2)
+            .sink_to_list()
+        )
+        with pytest.raises(StateNotRescalable, match="source"):
+            env2.execute("src", restore_from=d, timeout=120)
+
+    def test_online_training_rescales_by_key(self, tmp_path):
+        """Wide&Deep-style per-key models (scope='key') follow their keys
+        to the new subtasks."""
+        import numpy as np
+        import optax
+
+        from flink_tensorflow_tpu.functions import OnlineTrainFunction
+        from flink_tensorflow_tpu.models import get_model_def
+        from flink_tensorflow_tpu.tensors import RecordSchema, TensorValue, spec
+
+        mdef = get_model_def("widedeep", hash_buckets=50, embed_dim=2,
+                             num_cat_slots=2, num_dense=2, num_wide=4,
+                             hidden=(8,))
+        schema = RecordSchema({
+            "wide": spec((4,)),
+            "dense": spec((2,)),
+            "cat": spec((2,), np.int32),
+            "label": spec((), np.int32),
+        })
+        rng = np.random.RandomState(0)
+        records = [
+            TensorValue({
+                "wide": rng.rand(4).astype(np.float32),
+                "dense": rng.rand(2).astype(np.float32),
+                "cat": rng.randint(0, 50, (2,)).astype(np.int32),
+                "label": np.int32(i % 2),
+            }, meta={"user": i % 6})
+            for i in range(120)
+        ]
+
+        def build(env, parallelism):
+            return (
+                env.from_collection(records, parallelism=1)
+                .key_by(lambda r: r.meta["user"])
+                .process(
+                    OnlineTrainFunction(mdef, optax.sgd(0.05), train_schema=schema,
+                                        scope="key", mini_batch=4),
+                    name="train", parallelism=parallelism,
+                )
+                .sink_to_list()
+            )
+
+        d = str(tmp_path / "chk")
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d)
+        env.source_throttle_s = 0.02  # 120 records ~= 2.4s: the trigger
+        build(env, 2)                 # below lands mid-stream
+        h = env.execute_async("train")
+        time.sleep(0.5)
+        h.trigger_checkpoint()
+        h.cancel()
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        env2.enable_checkpointing(d)
+        out = build(env2, 3)
+        env2.execute("train", restore_from=d, timeout=300)
+        # Every key's model trained through its full (replayed) stream:
+        # 120 records / 6 users / mini_batch 4 = 5 steps per user.
+        steps = {}
+        for r in out:
+            steps[int(r.meta["key"])] = max(
+                steps.get(int(r.meta["key"]), 0), int(r["step"])
+            )
+        assert set(steps) == set(range(6))
+        assert all(s == 5 for s in steps.values()), steps
