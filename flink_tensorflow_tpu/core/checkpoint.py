@@ -197,16 +197,22 @@ class CheckpointCoordinator:
         call can drain them — they are never silently dropped."""
         import concurrent.futures
 
-        with self._lock:
-            futures = list(self._persist_futures)
-        if not futures:
-            return 0
-        _, not_done = concurrent.futures.wait(futures, timeout=timeout)
-        with self._lock:
-            self._persist_futures = [
-                f for f in self._persist_futures if f in not_done
-            ]
-        return len(not_done)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                futures = list(self._persist_futures)
+            if not futures:
+                return 0
+            budget = None if deadline is None else deadline - time.monotonic()
+            if budget is not None and budget <= 0:
+                return len(futures)
+            done, _ = concurrent.futures.wait(futures, timeout=budget)
+            with self._lock:
+                # Remove only what finished; checkpoints completing DURING
+                # the wait re-enter the loop and are drained too.
+                self._persist_futures = [
+                    f for f in self._persist_futures if f not in done
+                ]
 
     # -- subtask callbacks -------------------------------------------------
     def ack(self, checkpoint_id: int, task: str, subtask_index: int, snapshot: typing.Any) -> None:

@@ -90,10 +90,9 @@ class JobConfig:
     def validate(self) -> "JobConfig":
         if self.parallelism < 1:
             raise ValueError(f"parallelism must be >= 1, got {self.parallelism}")
-        if self.max_parallelism < self.parallelism:
+        if self.max_parallelism < 1:
             raise ValueError(
-                f"max_parallelism {self.max_parallelism} must be >= "
-                f"parallelism {self.parallelism}"
+                f"max_parallelism must be >= 1, got {self.max_parallelism}"
             )
         if self.channel_capacity < 1:
             raise ValueError(

@@ -28,6 +28,19 @@ from flink_tensorflow_tpu.core.operators import Operator, _FunctionOperator
 from flink_tensorflow_tpu.core.windows import TimeWindow, WindowBuffer
 
 
+def _min_watermark(states: typing.List[typing.Any]) -> float:
+    """Rescale-restore watermark: the min across old subtasks is the safe
+    (conservative) value on every new subtask."""
+    marks = [s["watermark"] for s in states if s]
+    return min(marks) if marks else -math.inf
+
+
+def _end_stamped_collector(output, end: float) -> fn.Collector:
+    """Results are stamped with the window end (Flink's maxTimestamp
+    convention) unless the function sets an explicit timestamp."""
+    return fn.Collector(lambda v, ts=None: output.emit(v, end if ts is None else ts))
+
+
 class TimestampAssignerOperator(Operator):
     """Assigns event timestamps + periodic watermarks.
 
@@ -77,16 +90,24 @@ class TimestampAssignerOperator(Operator):
 
 
 class EventTimeWindowOperator(_FunctionOperator):
-    """Tumbling event-time windows (keyed or global)."""
+    """Tumbling or sliding event-time windows (keyed or global).
+
+    ``slide_s=None`` (default) is tumbling; with a slide, each record
+    lands in ``ceil(size/slide)`` overlapping windows (Flink's sliding
+    assigner) and windows fire as the watermark passes their end.
+    """
 
     GLOBAL_KEY = "__subtask__"
 
     def __init__(self, name: str, function: fn.WindowFunction, size_s: float,
-                 key_selector=None):
+                 key_selector=None, slide_s: typing.Optional[float] = None):
         super().__init__(name, function)
         if size_s <= 0:
             raise ValueError(f"window size must be positive, got {size_s}")
+        if slide_s is not None and slide_s <= 0:
+            raise ValueError(f"window slide must be positive, got {slide_s}")
         self.size = float(size_s)
+        self.slide = float(slide_s) if slide_s is not None else float(size_s)
         self.key_selector = key_selector
         self._buffers: typing.Dict[typing.Tuple[typing.Any, float], WindowBuffer] = {}
         self._watermark = -math.inf
@@ -96,6 +117,24 @@ class EventTimeWindowOperator(_FunctionOperator):
         self._collector = fn.Collector(self.output.emit)
         super().open()
 
+    def _starts_for(self, ts: float) -> typing.Iterator[float]:
+        """Window starts whose [start, start+size) contains ts.
+
+        Computed in integer nanoseconds (Flink uses integer millis for
+        the same reason): float floor/multiply at slide boundaries
+        mis-assigns records whose timestamp is not binary-representable
+        (e.g. ts=0.3, slide=0.1 -> floor(0.3/0.1) == 2).
+        """
+        ts_ns = round(ts * 1e9)
+        slide_ns = round(self.slide * 1e9)
+        size_ns = round(self.size * 1e9)
+        start_ns = (ts_ns // slide_ns) * slide_ns
+        while start_ns > ts_ns - size_ns:
+            # End derives from the SAME integers so assignment and firing
+            # agree on boundaries (0.1 + 0.2 != 0.3 in floats).
+            yield start_ns / 1e9, (start_ns + size_ns) / 1e9
+            start_ns -= slide_ns
+
     def process_record(self, record: el.StreamRecord) -> None:
         if record.timestamp is None:
             raise ValueError(
@@ -103,15 +142,15 @@ class EventTimeWindowOperator(_FunctionOperator):
                 "timestamp — add .assign_timestamps(...) upstream"
             )
         ts = record.timestamp
-        start = math.floor(ts / self.size) * self.size
-        if start + self.size <= self._watermark:
-            return  # its window already fired: late, dropped (Flink rule)
         key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
-        buf = self._buffers.get((key, start))
-        if buf is None:
-            buf = WindowBuffer(window=TimeWindow(start, start + self.size))
-            self._buffers[(key, start)] = buf
-        buf.add(record.value, ts)
+        for start, end in self._starts_for(ts):
+            if end <= self._watermark:
+                continue  # that window already fired: late, dropped (Flink rule)
+            buf = self._buffers.get((key, start))
+            if buf is None:
+                buf = WindowBuffer(window=TimeWindow(start, end))
+                self._buffers[(key, start)] = buf
+            buf.add(record.value, ts)
 
     def process_watermark(self, watermark: el.Watermark) -> None:
         self._watermark = max(self._watermark, watermark.timestamp)
@@ -128,12 +167,7 @@ class EventTimeWindowOperator(_FunctionOperator):
         key = k[0]
         if self.key_selector is not None:
             self.keyed_state.current_key = key
-        # Results are stamped with the window end (Flink's maxTimestamp
-        # convention) unless the function sets an explicit timestamp.
-        end = buf.window.end
-        collector = fn.Collector(
-            lambda v, ts=None: self.output.emit(v, end if ts is None else ts)
-        )
+        collector = _end_stamped_collector(self.output, buf.window.end)
         self.function.process_window(
             key if self.key_selector is not None else None,
             buf.window,
@@ -161,12 +195,6 @@ class EventTimeWindowOperator(_FunctionOperator):
         from flink_tensorflow_tpu.core.operators import StateNotRescalable
 
         buffers = {}
-        # Watermark is per-subtask; the min across old subtasks is the
-        # safe (conservative) restore value on every new subtask.
-        watermark = -math.inf
-        marks = [s["watermark"] for s in states if s]
-        if marks:
-            watermark = min(marks)
         for s in states:
             if not s:
                 continue
@@ -178,4 +206,145 @@ class EventTimeWindowOperator(_FunctionOperator):
                     )
                 if mine(key):
                     buffers[(key, start)] = payload
-        return {"watermark": watermark, "buffers": buffers}
+        return {"watermark": _min_watermark(states), "buffers": buffers}
+
+
+class SessionWindowOperator(_FunctionOperator):
+    """Event-time session windows with a fixed inactivity gap.
+
+    A record at time t opens (or extends) a session [t, t+gap); sessions
+    that touch merge (Flink's merging window assigner).  A session fires
+    when the watermark passes its end — i.e. after ``gap_s`` of event
+    time with no activity for that key.  Fired elements are ordered by
+    timestamp (deterministic under out-of-order arrival).
+    """
+
+    GLOBAL_KEY = "__subtask__"
+
+    def __init__(self, name: str, function: fn.WindowFunction, gap_s: float,
+                 key_selector=None):
+        super().__init__(name, function)
+        if gap_s <= 0:
+            raise ValueError(f"session gap must be positive, got {gap_s}")
+        self.gap = float(gap_s)
+        self.key_selector = key_selector
+        #: Per key: list of open sessions (WindowBuffer with TimeWindow
+        #: whose end INCLUDES the gap).
+        self._sessions: typing.Dict[typing.Any, typing.List[WindowBuffer]] = {}
+        self._watermark = -math.inf
+        self._collector: typing.Optional[fn.Collector] = None
+
+    def open(self) -> None:
+        self._collector = fn.Collector(self.output.emit)
+        super().open()
+
+    def process_record(self, record: el.StreamRecord) -> None:
+        if record.timestamp is None:
+            raise ValueError(
+                f"{self.name}: session window got a record without a "
+                "timestamp — add .assign_timestamps(...) upstream"
+            )
+        ts = record.timestamp
+        key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
+        sessions = self._sessions.setdefault(key, [])
+        start, end = ts, ts + self.gap
+        overlaps = any(
+            s.window.start < end and start < s.window.end for s in sessions
+        )
+        if not overlaps and end <= self._watermark:
+            # Late only if it can neither merge into a live session nor
+            # survive alone (a merging assigner keeps an out-of-order
+            # record whose bridged session is still open — Flink rule).
+            return
+        merged = WindowBuffer(window=TimeWindow(start, end))
+        merged.add(record.value, ts)
+        keep = []
+        for s in sessions:
+            # Sessions are half-open [start, end); touching means overlap.
+            if s.window.start < merged.window.end and merged.window.start < s.window.end:
+                lo = min(s.window.start, merged.window.start)
+                hi = max(s.window.end, merged.window.end)
+                nxt = WindowBuffer(window=TimeWindow(lo, hi))
+                nxt.elements = s.elements + merged.elements
+                nxt.timestamps = s.timestamps + merged.timestamps
+                nxt.first_element_time = min(s.first_element_time,
+                                             merged.first_element_time)
+                merged = nxt
+            else:
+                keep.append(s)
+        keep.append(merged)
+        self._sessions[key] = keep
+
+    def process_watermark(self, watermark: el.Watermark) -> None:
+        self._watermark = max(self._watermark, watermark.timestamp)
+        due = []
+        for key, sessions in self._sessions.items():
+            for s in sessions:
+                if s.window.end <= self._watermark:
+                    due.append((key, s))
+        for key, s in sorted(due, key=lambda ks: (ks[1].window.end, str(ks[0]))):
+            self._sessions[key].remove(s)
+            self._fire(key, s)
+        self._sessions = {k: v for k, v in self._sessions.items() if v}
+        self.output.broadcast_element(watermark)
+
+    def _fire(self, key, s: WindowBuffer) -> None:
+        if self.key_selector is not None:
+            self.keyed_state.current_key = key
+        order = sorted(range(len(s.elements)), key=lambda i: s.timestamps[i])
+        elements = [s.elements[i] for i in order]
+        collector = _end_stamped_collector(self.output, s.window.end)
+        self.function.process_window(
+            key if self.key_selector is not None else None,
+            s.window,
+            elements,
+            collector,
+        )
+
+    def finish(self) -> None:
+        due = []
+        for key, sessions in self._sessions.items():
+            due.extend((key, s) for s in sessions)
+        for key, s in sorted(due, key=lambda ks: (ks[1].window.end, str(ks[0]))):
+            self._fire(key, s)
+        self._sessions.clear()
+        self.function.on_finish(self._collector)
+
+    def _operator_snapshot(self):
+        return {
+            "watermark": self._watermark,
+            "sessions": {
+                key: [(s.window, list(s.elements), list(s.timestamps))
+                      for s in sessions]
+                for key, sessions in self._sessions.items()
+            },
+        }
+
+    def _operator_restore(self, state):
+        self._watermark = state["watermark"]
+        self._sessions = {}
+        for key, sessions in state["sessions"].items():
+            out = []
+            for window, elements, timestamps in sessions:
+                s = WindowBuffer(window=window)
+                s.elements = list(elements)
+                s.timestamps = list(timestamps)
+                out.append(s)
+            self._sessions[key] = out
+
+    def _rescale_operator_state(self, states, mine):
+        from flink_tensorflow_tpu.core.operators import StateNotRescalable
+
+        sessions: typing.Dict[typing.Any, list] = {}
+        for s in states:
+            if not s:
+                continue
+            for key, payload in s["sessions"].items():
+                if key == self.GLOBAL_KEY:
+                    raise StateNotRescalable(
+                        f"operator {self.name!r}: non-keyed sessions are "
+                        "per-subtask"
+                    )
+                if mine(key):
+                    sessions.setdefault(key, []).extend(payload)
+        return {"watermark": _min_watermark(states), "sessions": sessions}

@@ -331,9 +331,11 @@ class WindowOperator(_FunctionOperator):
         value = record.value
         # Zero-copy ingestion: tensor window functions may take the record
         # payload NOW (into their ring arena) and buffer only a token —
-        # non-keyed only, so buffer order equals arena FIFO order.
+        # non-keyed only, and never for retaining (sliding) triggers:
+        # fired slots recycle their payload, but a retained element must
+        # survive into the next window.
         ingest = getattr(self.function, "ingest_element", None)
-        if ingest is not None and self.key_selector is None:
+        if ingest is not None and self.key_selector is None and not self.trigger.retains():
             token = ingest(value, self._collector)
             if token is not None:
                 value = token
@@ -343,15 +345,26 @@ class WindowOperator(_FunctionOperator):
 
     def _fire(self, key, buf: WindowBuffer) -> None:
         del self._buffers[key]
-        self._window_seq[key] = self._window_seq.get(key, 0) + 1
+        seq = self._window_seq.get(key, 0) + 1
+        self._window_seq[key] = seq
         if self.key_selector is not None:
             self.keyed_state.current_key = key
         self.function.process_window(
             key if self.key_selector is not None else None,
             buf.window,
-            buf.elements,
+            self.trigger.fire_elements(buf),
             self._collector,
         )
+        # Sliding windows: seed the next buffer with the trailing overlap.
+        keep = self.trigger.retain_count(buf)
+        if keep:
+            from flink_tensorflow_tpu.core.windows import CountWindow
+
+            nxt = WindowBuffer(window=CountWindow(seq), retained=keep)
+            nxt.elements = list(buf.elements[-keep:])
+            nxt.timestamps = list(buf.timestamps[-keep:])
+            nxt.first_element_time = time.monotonic()
+            self._buffers[key] = nxt
 
     def next_deadline(self):
         deadlines = [
@@ -378,7 +391,13 @@ class WindowOperator(_FunctionOperator):
 
     def finish(self):
         for key in list(self._buffers.keys()):
-            self._fire(key, self._buffers[key])
+            buf = self._buffers[key]
+            # A buffer holding ONLY carried-over elements (sliding
+            # retention) has emitted everything already — re-firing it
+            # would duplicate; flush only windows with new arrivals.
+            if len(buf.elements) > buf.retained:
+                self._fire(key, buf)
+        self._buffers.clear()
         self.function.on_finish(self._collector)
 
     def _operator_snapshot(self):

@@ -235,12 +235,17 @@ class LocalExecutor:
 
         order = self.graph.topological_order()
 
+        from flink_tensorflow_tpu.core.partitioning import HashPartitioner
+
         for t in order:
-            if t.parallelism > self.max_parallelism:
+            keyed = any(isinstance(e.partitioner, HashPartitioner) for e in t.inputs)
+            if keyed and t.parallelism > self.max_parallelism:
+                # Non-keyed operators hold no key-partitioned state and
+                # may exceed the bound freely (Flink's rule).
                 raise ValueError(
-                    f"operator {t.name!r} parallelism {t.parallelism} exceeds "
-                    f"max_parallelism {self.max_parallelism} — key groups "
-                    "would starve the subtasks above the bound; raise "
+                    f"keyed operator {t.name!r} parallelism {t.parallelism} "
+                    f"exceeds max_parallelism {self.max_parallelism} — key "
+                    "groups would starve the subtasks above the bound; raise "
                     "JobConfig.max_parallelism"
                 )
 

@@ -32,7 +32,26 @@ from flink_tensorflow_tpu.core.partitioning import (
     Partitioner,
     RebalancePartitioner,
 )
-from flink_tensorflow_tpu.core.windows import CountOrTimeoutTrigger, CountTrigger, Trigger
+from flink_tensorflow_tpu.core.windows import (
+    CountOrTimeoutTrigger,
+    CountTrigger,
+    SlidingCountTrigger,
+    Trigger,
+)
+
+
+def _count_trigger(size: int, slide: typing.Optional[int],
+                   timeout_s: typing.Optional[float]) -> Trigger:
+    if slide is not None:
+        if timeout_s is not None:
+            raise ValueError(
+                "sliding count windows do not take timeout_s (a sliding "
+                "fire is driven by arrivals, not deadlines)"
+            )
+        return SlidingCountTrigger(size, slide)
+    if timeout_s is not None:
+        return CountOrTimeoutTrigger(size, timeout_s)
+    return CountTrigger(size)
 
 if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.core.environment import StreamExecutionEnvironment
@@ -165,23 +184,32 @@ class DataStream:
         )
         return DataStream(self.env, t)
 
-    def time_window_all(self, size_s: float) -> "EventTimeWindowedStream":
-        """Tumbling event-time window over the whole (per-subtask) stream."""
-        return EventTimeWindowedStream(self.env, self, size_s, key_selector=None)
+    def time_window_all(
+        self, size_s: float, slide_s: typing.Optional[float] = None
+    ) -> "EventTimeWindowedStream":
+        """Tumbling (or, with ``slide_s``, sliding) event-time window over
+        the whole (per-subtask) stream."""
+        return EventTimeWindowedStream(self.env, self, size_s, key_selector=None,
+                                       slide_s=slide_s)
+
+    def session_window_all(self, gap_s: float) -> "SessionWindowedStream":
+        """Event-time session windows (fixed inactivity gap), non-keyed."""
+        return SessionWindowedStream(self.env, self, gap_s, key_selector=None)
 
     # -- windows ----------------------------------------------------------
     def count_window(
-        self, size: int, *, timeout_s: typing.Optional[float] = None
+        self, size: int, *, slide: typing.Optional[int] = None,
+        timeout_s: typing.Optional[float] = None,
     ) -> "WindowedStream":
-        """Per-subtask tumbling count window (the micro-batch primitive).
+        """Per-subtask count window (the micro-batch primitive).
 
         ``timeout_s`` turns it into the adaptive count-or-timeout batcher
-        bounding p50 latency (SURVEY.md §7 hard part 3).
+        bounding p50 latency (SURVEY.md §7 hard part 3).  ``slide`` makes
+        it a sliding window: fire every ``slide`` records with the last
+        ``size`` (overlapping micro-batches; incompatible with timeout_s).
         """
-        trigger = (
-            CountTrigger(size) if timeout_s is None else CountOrTimeoutTrigger(size, timeout_s)
-        )
-        return WindowedStream(self.env, self, trigger, key_selector=None)
+        return WindowedStream(self.env, self, _count_trigger(size, slide, timeout_s),
+                              key_selector=None)
 
     # -- sinks ------------------------------------------------------------
     def add_sink(self, sink: fn.SinkFunction, *, name="sink", parallelism=None) -> Transformation:
@@ -237,16 +265,26 @@ class KeyedStream:
         )
         return DataStream(self.env, t)
 
-    def count_window(self, size: int, *, timeout_s: typing.Optional[float] = None) -> "WindowedStream":
-        trigger = (
-            CountTrigger(size) if timeout_s is None else CountOrTimeoutTrigger(size, timeout_s)
-        )
-        return WindowedStream(self.env, self, trigger, key_selector=self.key_selector)
+    def count_window(
+        self, size: int, *, slide: typing.Optional[int] = None,
+        timeout_s: typing.Optional[float] = None,
+    ) -> "WindowedStream":
+        return WindowedStream(self.env, self, _count_trigger(size, slide, timeout_s),
+                              key_selector=self.key_selector)
 
-    def time_window(self, size_s: float) -> "EventTimeWindowedStream":
-        """Tumbling event-time window per key (records must carry
-        timestamps — see DataStream.assign_timestamps)."""
-        return EventTimeWindowedStream(self.env, self, size_s, key_selector=self.key_selector)
+    def time_window(
+        self, size_s: float, slide_s: typing.Optional[float] = None
+    ) -> "EventTimeWindowedStream":
+        """Tumbling (or, with ``slide_s``, sliding) event-time window per
+        key (records must carry timestamps — see assign_timestamps)."""
+        return EventTimeWindowedStream(self.env, self, size_s,
+                                       key_selector=self.key_selector,
+                                       slide_s=slide_s)
+
+    def session_window(self, gap_s: float) -> "SessionWindowedStream":
+        """Per-key event-time session windows (fixed inactivity gap)."""
+        return SessionWindowedStream(self.env, self, gap_s,
+                                     key_selector=self.key_selector)
 
     def reduce(self, f: typing.Union["fn.ReduceFunction", typing.Callable], *,
                name="reduce", parallelism=None) -> DataStream:
@@ -288,12 +326,14 @@ class _ReduceProcess(fn.ProcessFunction):
 
 
 class EventTimeWindowedStream:
-    """Tumbling event-time windows; fire on watermark passage."""
+    """Tumbling/sliding event-time windows; fire on watermark passage."""
 
-    def __init__(self, env, upstream, size_s: float, key_selector):
+    def __init__(self, env, upstream, size_s: float, key_selector,
+                 slide_s: typing.Optional[float] = None):
         self.env = env
         self.upstream = upstream  # DataStream or KeyedStream
         self.size_s = size_s
+        self.slide_s = slide_s
         self.key_selector = key_selector
 
     def apply(self, f: fn.WindowFunction, *, name="time_window", parallelism=None) -> DataStream:
@@ -307,7 +347,35 @@ class EventTimeWindowedStream:
         t = self.env.graph.add(
             name,
             lambda: EventTimeWindowOperator(name, f, self.size_s,
-                                            key_selector=self.key_selector),
+                                            key_selector=self.key_selector,
+                                            slide_s=self.slide_s),
+            parallelism,
+            inputs=[edge],
+        )
+        return DataStream(self.env, t)
+
+
+class SessionWindowedStream:
+    """Event-time session windows (fixed inactivity gap)."""
+
+    def __init__(self, env, upstream, gap_s: float, key_selector):
+        self.env = env
+        self.upstream = upstream  # DataStream or KeyedStream
+        self.gap_s = gap_s
+        self.key_selector = key_selector
+
+    def apply(self, f: fn.WindowFunction, *, name="session_window", parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.event_time import SessionWindowOperator
+
+        parallelism = parallelism or self.env.default_parallelism
+        if isinstance(self.upstream, KeyedStream):
+            edge = self.upstream._edge()
+        else:
+            edge = self.upstream._edge(parallelism)
+        t = self.env.graph.add(
+            name,
+            lambda: SessionWindowOperator(name, f, self.gap_s,
+                                          key_selector=self.key_selector),
             parallelism,
             inputs=[edge],
         )

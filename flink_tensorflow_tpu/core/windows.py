@@ -46,6 +46,22 @@ class Trigger:
         """Processing-time deadline at which the window must flush, or None."""
         return None
 
+    # -- retention (sliding windows) -----------------------------------
+    def retains(self) -> bool:
+        """True when fires carry elements over into the next window
+        (sliding semantics).  Retaining triggers are incompatible with
+        zero-copy ring ingestion (fired slots recycle their payload)."""
+        return False
+
+    def fire_elements(self, window_state: "WindowBuffer") -> typing.List[typing.Any]:
+        """The elements a fire emits (sliding triggers trim to the window
+        size; tumbling fires emit everything)."""
+        return window_state.elements
+
+    def retain_count(self, window_state: "WindowBuffer") -> int:
+        """How many TRAILING elements to seed the next window with."""
+        return 0
+
 
 class CountTrigger(Trigger):
     def __init__(self, count: int):
@@ -83,6 +99,33 @@ class CountOrTimeoutTrigger(Trigger):
         return window_state.first_element_time + self.timeout_s
 
 
+class SlidingCountTrigger(Trigger):
+    """Fire every ``slide`` new elements, emitting the last ``size``.
+
+    Flink's ``countWindow(size, slide)``: early windows are partial
+    (first fire after ``slide`` elements), steady-state windows overlap —
+    each fire carries the trailing ``size - slide`` elements forward.
+    """
+
+    def __init__(self, size: int, slide: int):
+        if size <= 0 or slide <= 0:
+            raise ValueError(f"size and slide must be positive, got {size}, {slide}")
+        self.size = size
+        self.slide = slide
+
+    def on_element(self, window_state):
+        return len(window_state.elements) - window_state.retained >= self.slide
+
+    def retains(self):
+        return True
+
+    def fire_elements(self, window_state):
+        return window_state.elements[-self.size:]
+
+    def retain_count(self, window_state):
+        return min(len(window_state.elements), max(0, self.size - self.slide))
+
+
 @dataclasses.dataclass
 class WindowBuffer:
     """Accumulating contents of one in-flight window."""
@@ -91,6 +134,9 @@ class WindowBuffer:
     elements: typing.List[typing.Any] = dataclasses.field(default_factory=list)
     timestamps: typing.List[typing.Optional[float]] = dataclasses.field(default_factory=list)
     first_element_time: float = 0.0
+    #: Number of leading elements carried over from the previous fire
+    #: (sliding windows) — triggers count "new" arrivals past this.
+    retained: int = 0
 
     def add(self, value: typing.Any, timestamp: typing.Optional[float]) -> None:
         if not self.elements:
@@ -103,15 +149,16 @@ def snapshot_buffers(buffers: typing.Mapping[typing.Any, WindowBuffer]) -> dict:
     """Picklable snapshot of open windows (shared by the count/timeout and
     event-time window operators — one format, one restore path)."""
     return {
-        key: (buf.window, list(buf.elements), list(buf.timestamps))
+        key: (buf.window, list(buf.elements), list(buf.timestamps), buf.retained)
         for key, buf in buffers.items()
     }
 
 
 def restore_buffers(snap: dict) -> typing.Dict[typing.Any, WindowBuffer]:
     out: typing.Dict[typing.Any, WindowBuffer] = {}
-    for key, (window, elements, timestamps) in snap.items():
-        buf = WindowBuffer(window=window)
+    for key, (window, elements, timestamps, *rest) in snap.items():
+        # Pre-sliding-window checkpoints carry no retained count.
+        buf = WindowBuffer(window=window, retained=rest[0] if rest else 0)
         buf.elements = list(elements)
         buf.timestamps = list(timestamps)
         # Restart resets the processing-time clock: timeout triggers count

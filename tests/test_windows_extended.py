@@ -1,0 +1,236 @@
+"""Sliding count, sliding event-time, and session windows.
+
+VERDICT r1 missing #5: only tumbling count/time windows existed; the
+reference inherits Flink's full window surface (SURVEY.md §1 L1).
+"""
+
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.windows import SlidingCountTrigger
+
+
+class Collect(fn.WindowFunction):
+    """Emits each fired window as a list."""
+
+    def process_window(self, key, window, elements, out):
+        out.collect((key, list(elements)))
+
+
+def _run(env):
+    env.execute("win", timeout=60)
+
+
+class TestSlidingCountWindows:
+    def test_non_keyed_slide(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_collection(list(range(10)), parallelism=1)
+            .count_window(4, slide=2)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        windows = [w for _, w in out]
+        # Every 2 records, last 4: [0,1], [0..3], [2..5], [4..7], [6..9]
+        assert windows == [
+            [0, 1], [0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7], [6, 7, 8, 9],
+        ]
+
+    def test_trailing_partial_flushes_once(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_collection(list(range(7)), parallelism=1)
+            .count_window(4, slide=2)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        windows = [w for _, w in out]
+        # Fires at 2, 4, 6; end-of-input flushes the one new record (6)
+        # with its retained overlap [4, 5] — retained-only buffers must
+        # NOT re-fire.
+        assert windows == [[0, 1], [0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6]]
+
+    def test_keyed_slide(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = [{"k": i % 2, "v": i} for i in range(8)]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .key_by(lambda r: r["k"])
+            .count_window(2, slide=1)
+            .apply(Collect(), name="w", parallelism=2)
+            .sink_to_list()
+        )
+        _run(env)
+        by_key = {}
+        for key, w in out:
+            by_key.setdefault(key, []).append([r["v"] for r in w])
+        assert by_key[0] == [[0], [0, 2], [2, 4], [4, 6]]
+        assert by_key[1] == [[1], [1, 3], [3, 5], [5, 7]]
+
+    def test_slide_larger_than_size_trims(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_collection(list(range(9)), parallelism=1)
+            .count_window(2, slide=3)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        windows = [w for _, w in out]
+        # Fire every 3, emit last 2 (records 2 are skipped entirely —
+        # Flink's hopping-window semantics).
+        assert windows == [[1, 2], [4, 5], [7, 8]]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SlidingCountTrigger(0, 1)
+        env = StreamExecutionEnvironment(parallelism=1)
+        with pytest.raises(ValueError, match="timeout_s"):
+            env.from_collection([1]).count_window(4, slide=2, timeout_s=1.0)
+
+
+class TestSlidingTimeWindows:
+    def test_overlapping_assignment(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # Records at t=0..5; size 2s, slide 1s.
+        records = [{"t": float(i), "v": i} for i in range(6)]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .time_window_all(2.0, slide_s=1.0)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        windows = [[r["v"] for r in w] for _, w in out]
+        # Window [-1,1): {0}; [0,2): {0,1}; [1,3): {1,2}; ... [5,7): {5}
+        assert windows == [[0], [0, 1], [1, 2], [2, 3], [3, 4], [4, 5], [5]]
+
+    def test_keyed_sliding_time(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = [{"k": i % 2, "t": float(i), "v": i} for i in range(6)]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .key_by(lambda r: r["k"])
+            .time_window(4.0, slide_s=2.0)
+            .apply(Collect(), name="w", parallelism=2)
+            .sink_to_list()
+        )
+        _run(env)
+        by_key = {}
+        for key, w in out:
+            by_key.setdefault(key, []).append(sorted(r["v"] for r in w))
+        # key 0 at t=0,2,4; windows [-2,2):{0}, [0,4):{0,2}, [2,6):{2,4}, [4,8):{4}
+        assert by_key[0] == [[0], [0, 2], [2, 4], [4]]
+        assert by_key[1] == [[1], [1, 3], [3, 5], [5]]
+
+
+class TestSessionWindows:
+    def test_sessions_split_on_gap(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # Two activity bursts per key separated by > gap.  b's record
+        # arrives before the watermark advances past its session (a
+        # record this far behind the max seen timestamp WOULD be late-
+        # dropped, correctly, if it arrived after burst 2).
+        records = (
+            [{"k": "a", "t": 0.0}, {"k": "b", "t": 0.2}]
+            + [{"k": "a", "t": t} for t in (0.5, 1.0)]
+            + [{"k": "a", "t": t} for t in (10.0, 10.4)]
+        )
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .key_by(lambda r: r["k"])
+            .session_window(2.0)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        got = sorted(
+            (key, [r["t"] for r in w]) for key, w in out
+        )
+        assert got == [
+            ("a", [0.0, 0.5, 1.0]),
+            ("a", [10.0, 10.4]),
+            ("b", [0.2]),
+        ]
+
+    def test_out_of_order_merges_sessions(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # 0.0 and 3.0 are separate sessions (gap 2) until 1.5 arrives and
+        # bridges them into one.
+        records = [{"t": 0.0}, {"t": 3.0}, {"t": 1.5}]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], out_of_orderness_s=5.0,
+                               watermark_every=1)
+            .session_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        assert len(out) == 1
+        _, w = out[0]
+        assert [r["t"] for r in w] == [0.0, 1.5, 3.0]  # timestamp order
+
+    def test_late_record_still_merges_into_open_session(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # After t=10,12 (gap 5 -> open session [10,17), wm=12), the
+        # record at t=6 is late STANDALONE ([6,11) ends before wm) but
+        # overlaps the open session -> merged [6,17): a merging assigner
+        # keeps it (Flink rule); late only when it can neither merge nor
+        # survive alone.
+        records = [{"t": 10.0}, {"t": 12.0}, {"t": 6.0}]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], out_of_orderness_s=0.0,
+                               watermark_every=1)
+            .session_window_all(5.0)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        assert len(out) == 1
+        assert [r["t"] for r in out[0][1]] == [6.0, 10.0, 12.0]
+
+    def test_session_checkpoint_restore(self, tmp_path):
+        import time as _time
+
+        d = str(tmp_path / "chk")
+
+        def build(env):
+            records = [{"k": i % 3, "t": float(i)} for i in range(60)]
+            return (
+                env.from_collection(records, parallelism=1)
+                .assign_timestamps(lambda r: r["t"], watermark_every=4)
+                .key_by(lambda r: r["k"])
+                # Each key's events are 3s apart; gap 4 chains them all
+                # into one session per key.
+                .session_window(4.0)
+                .apply(Collect(), name="sessions", parallelism=1)
+                .sink_to_list()
+            )
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d)
+        env.source_throttle_s = 0.005
+        build(env)
+        h = env.execute_async("sess")
+        _time.sleep(0.15)
+        h.trigger_checkpoint()
+        h.cancel()
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        env2.enable_checkpointing(d)
+        out = build(env2)
+        env2.execute("sess", restore_from=d, timeout=60)
+        # Keys are 1 apart within each key's stream (gap 1.5 merges all):
+        # each key ends with ONE session holding all 20 of its records.
+        per_key = {}
+        for key, w in out:
+            per_key[key] = max(per_key.get(key, 0), len(w))
+        assert per_key == {0: 20, 1: 20, 2: 20}
