@@ -117,7 +117,7 @@ class EventTimeWindowOperator(_FunctionOperator):
         self._collector = fn.Collector(self.output.emit)
         super().open()
 
-    def _starts_for(self, ts: float) -> typing.Iterator[float]:
+    def _starts_for(self, ts: float) -> typing.Iterator[typing.Tuple[float, float]]:
         """Window starts whose [start, start+size) contains ts.
 
         Computed in integer nanoseconds (Flink uses integer millis for
@@ -249,7 +249,7 @@ class SessionWindowOperator(_FunctionOperator):
         sessions = self._sessions.setdefault(key, [])
         start, end = ts, ts + self.gap
         overlaps = any(
-            s.window.start < end and start < s.window.end for s in sessions
+            s.window.start <= end and start <= s.window.end for s in sessions
         )
         if not overlaps and end <= self._watermark:
             # Late only if it can neither merge into a live session nor
@@ -260,8 +260,9 @@ class SessionWindowOperator(_FunctionOperator):
         merged.add(record.value, ts)
         keep = []
         for s in sessions:
-            # Sessions are half-open [start, end); touching means overlap.
-            if s.window.start < merged.window.end and merged.window.start < s.window.end:
+            # Touching counts as overlap (Flink's inclusive intersects):
+            # records exactly gap_s apart chain into one session.
+            if s.window.start <= merged.window.end and merged.window.start <= s.window.end:
                 lo = min(s.window.start, merged.window.start)
                 hi = max(s.window.end, merged.window.end)
                 nxt = WindowBuffer(window=TimeWindow(lo, hi))
@@ -283,7 +284,9 @@ class SessionWindowOperator(_FunctionOperator):
                 if s.window.end <= self._watermark:
                     due.append((key, s))
         for key, s in sorted(due, key=lambda ks: (ks[1].window.end, str(ks[0]))):
-            self._sessions[key].remove(s)
+            # Remove by IDENTITY: the dataclass __eq__ would compare
+            # element lists, and numpy payloads make that ambiguous.
+            self._sessions[key] = [x for x in self._sessions[key] if x is not s]
             self._fire(key, s)
         self._sessions = {k: v for k, v in self._sessions.items() if v}
         self.output.broadcast_element(watermark)

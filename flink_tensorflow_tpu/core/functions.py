@@ -137,6 +137,51 @@ class WindowFunction(RichFunction, abc.ABC):
         asynchronously in-flight work (e.g. pipelined model batches)."""
 
 
+class CoMapFunction(RichFunction, abc.ABC):
+    """Two-input map (``stream1.connect(stream2).map(f)``): one method
+    per input, shared function state — the Flink ``CoMapFunction``."""
+
+    @abc.abstractmethod
+    def map1(self, value: typing.Any) -> typing.Any: ...
+
+    @abc.abstractmethod
+    def map2(self, value: typing.Any) -> typing.Any: ...
+
+
+class CoFlatMapFunction(RichFunction, abc.ABC):
+    @abc.abstractmethod
+    def flat_map1(self, value: typing.Any) -> typing.Iterable[typing.Any]: ...
+
+    @abc.abstractmethod
+    def flat_map2(self, value: typing.Any) -> typing.Iterable[typing.Any]: ...
+
+
+class CoProcessFunction(RichFunction, abc.ABC):
+    """Two-input process function with keyed state + timers shared across
+    both inputs — the primitive behind joins, enrichment, and
+    control-stream patterns (Flink ``CoProcessFunction``/
+    ``KeyedCoProcessFunction``)."""
+
+    @abc.abstractmethod
+    def process_element1(self, value, ctx: "ProcessContext", out: Collector) -> None: ...
+
+    @abc.abstractmethod
+    def process_element2(self, value, ctx: "ProcessContext", out: Collector) -> None: ...
+
+    def on_timer(self, timestamp: float, ctx: "ProcessContext", out: Collector) -> None:  # noqa: B027
+        pass
+
+    def on_finish(self, out: Collector) -> None:  # noqa: B027
+        pass
+
+
+class JoinFunction(RichFunction, abc.ABC):
+    """Combines one left and one right element of a matched pair."""
+
+    @abc.abstractmethod
+    def join(self, left: typing.Any, right: typing.Any) -> typing.Any: ...
+
+
 class SourceFunction(RichFunction, abc.ABC):
     """Pull-based source: yields values; offset tracking enables replay."""
 

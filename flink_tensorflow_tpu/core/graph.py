@@ -45,6 +45,7 @@ class DataflowGraph:
     def __init__(self) -> None:
         self.transformations: typing.List[Transformation] = []
         self._next_id = 0
+        self._names: typing.Set[str] = set()
 
     def add(
         self,
@@ -56,9 +57,18 @@ class DataflowGraph:
     ) -> Transformation:
         if parallelism <= 0:
             raise ValueError(f"parallelism must be positive, got {parallelism}")
+        # Task names key snapshots and metric scopes — two operators
+        # sharing a (default) name would merge/overwrite each other's
+        # checkpoint state, so collisions get a deterministic suffix.
+        unique = name
+        n = 2
+        while unique in self._names:
+            unique = f"{name}_{n}"
+            n += 1
+        self._names.add(unique)
         t = Transformation(
             id=self._next_id,
-            name=name,
+            name=unique,
             operator_factory=operator_factory,
             parallelism=parallelism,
             inputs=list(inputs or []),

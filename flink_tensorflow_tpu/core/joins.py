@@ -1,0 +1,224 @@
+"""Two-input joins — window join and interval join over event time.
+
+Flink's join surface on the DataStream API (the substrate the reference
+inherits, SURVEY.md §1 L1): a **window join** pairs all (left, right)
+elements sharing a key inside the same tumbling event-time window; an
+**interval join** pairs each left element with right elements whose
+timestamp lies in ``[l.ts + lower, l.ts + upper]``.
+
+Both are built as two-input operators on the runtime's indexed-dispatch
+path (``process_record_from``), with keyed buffers that snapshot,
+restore, and rescale by key group like every other keyed state.
+"""
+
+from __future__ import annotations
+
+import math
+import typing
+
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.operators import _FunctionOperator
+
+
+class _LambdaJoin(fn.JoinFunction):
+    def __init__(self, f):
+        self.f = f
+
+    def join(self, left, right):
+        return self.f(left, right)
+
+
+def as_join_function(f) -> fn.JoinFunction:
+    return f if isinstance(f, fn.JoinFunction) else _LambdaJoin(f)
+
+
+class WindowJoinOperator(_FunctionOperator):
+    """Tumbling event-time window join: for each (key, window), emits
+    ``join(l, r)`` for every left x right pair once the watermark passes
+    the window end.  Results are stamped with the window end."""
+
+    def __init__(self, name: str, function: fn.JoinFunction, size_s: float,
+                 key_selector1, key_selector2):
+        super().__init__(name, function)
+        if size_s <= 0:
+            raise ValueError(f"window size must be positive, got {size_s}")
+        self.size = float(size_s)
+        self.key_selector1 = key_selector1
+        self.key_selector2 = key_selector2
+        #: {(key, start): (left elements, right elements)}
+        self._buffers: typing.Dict[typing.Tuple[typing.Any, float],
+                                   typing.Tuple[list, list]] = {}
+        self._watermark = -math.inf
+
+    def process_record(self, record):  # pragma: no cover - indexed dispatch only
+        raise RuntimeError("two-input operator requires process_record_from")
+
+    def process_record_from(self, input_index, record: el.StreamRecord) -> None:
+        if record.timestamp is None:
+            raise ValueError(
+                f"{self.name}: window join got a record without a timestamp "
+                "— add .assign_timestamps(...) upstream of both inputs"
+            )
+        ts = record.timestamp
+        size_ns = round(self.size * 1e9)
+        start_ns = (round(ts * 1e9) // size_ns) * size_ns
+        start, end = start_ns / 1e9, (start_ns + size_ns) / 1e9
+        if end <= self._watermark:
+            return  # late, window already fired
+        selector = self.key_selector1 if input_index == 0 else self.key_selector2
+        key = selector(record.value)
+        sides = self._buffers.get((key, start))
+        if sides is None:
+            sides = ([], [])
+            self._buffers[(key, start)] = sides
+        sides[input_index].append(record.value)
+
+    def process_watermark(self, watermark: el.Watermark) -> None:
+        self._watermark = max(self._watermark, watermark.timestamp)
+        size = self.size
+        due = sorted(
+            (k for k in self._buffers if k[1] + size <= self._watermark),
+            key=lambda k: (k[1], str(k[0])),
+        )
+        for k in due:
+            self._fire(k)
+        self.output.broadcast_element(watermark)
+
+    def _fire(self, k) -> None:
+        left, right = self._buffers.pop(k)
+        key, start = k
+        self.keyed_state.current_key = key
+        end = start + self.size
+        for l in left:
+            for r in right:
+                self.output.emit(self.function.join(l, r), end)
+
+    def finish(self) -> None:
+        for k in sorted(self._buffers.keys(), key=lambda k: (k[1], str(k[0]))):
+            self._fire(k)
+
+    def _operator_snapshot(self):
+        return {
+            "watermark": self._watermark,
+            "buffers": {k: (list(l), list(r)) for k, (l, r) in self._buffers.items()},
+        }
+
+    def _operator_restore(self, state):
+        self._watermark = state["watermark"]
+        self._buffers = {
+            tuple(k): (list(l), list(r)) for k, (l, r) in state["buffers"].items()
+        }
+
+    def _rescale_operator_state(self, states, mine):
+        from flink_tensorflow_tpu.core.event_time import _min_watermark
+
+        buffers = {}
+        for s in states:
+            if not s:
+                continue
+            for (key, start), (l, r) in s["buffers"].items():
+                if mine(key):
+                    buffers[(key, start)] = (list(l), list(r))
+        return {"watermark": _min_watermark(states), "buffers": buffers}
+
+
+class IntervalJoinOperator(_FunctionOperator):
+    """Event-time interval join (Flink ``intervalJoin``): emits
+    ``join(l, r)`` whenever ``l.ts + lower <= r.ts <= l.ts + upper``.
+
+    Each side buffers per key; arrivals probe the other side immediately
+    (results stamped ``max(l.ts, r.ts)``), and watermark passage evicts
+    elements that can no longer match any future arrival."""
+
+    def __init__(self, name: str, function: fn.JoinFunction,
+                 lower_s: float, upper_s: float,
+                 key_selector1, key_selector2):
+        super().__init__(name, function)
+        if lower_s > upper_s:
+            raise ValueError(f"interval lower {lower_s} > upper {upper_s}")
+        self.lower = float(lower_s)
+        self.upper = float(upper_s)
+        self.key_selector1 = key_selector1
+        self.key_selector2 = key_selector2
+        #: Per key: ([(ts, left value)], [(ts, right value)]).
+        self._state: typing.Dict[typing.Any, typing.Tuple[list, list]] = {}
+        self._watermark = -math.inf
+
+    def process_record(self, record):  # pragma: no cover - indexed dispatch only
+        raise RuntimeError("two-input operator requires process_record_from")
+
+    def process_record_from(self, input_index, record: el.StreamRecord) -> None:
+        if record.timestamp is None:
+            raise ValueError(
+                f"{self.name}: interval join got a record without a timestamp "
+                "— add .assign_timestamps(...) upstream of both inputs"
+            )
+        ts = record.timestamp
+        # An element is late when it could no longer be emitted against
+        # even a fresh opposite-side arrival at the watermark.
+        horizon = ts + self.upper if input_index == 0 else ts - self.lower
+        if horizon < self._watermark:
+            return
+        selector = self.key_selector1 if input_index == 0 else self.key_selector2
+        key = selector(record.value)
+        sides = self._state.get(key)
+        if sides is None:
+            sides = ([], [])
+            self._state[key] = sides
+        sides[input_index].append((ts, record.value))
+        self.keyed_state.current_key = key
+        if input_index == 0:
+            for rts, rv in sides[1]:
+                if ts + self.lower <= rts <= ts + self.upper:
+                    self.output.emit(self.function.join(record.value, rv),
+                                     max(ts, rts))
+        else:
+            for lts, lv in sides[0]:
+                if lts + self.lower <= ts <= lts + self.upper:
+                    self.output.emit(self.function.join(lv, record.value),
+                                     max(ts, lts))
+
+    def process_watermark(self, watermark: el.Watermark) -> None:
+        self._watermark = max(self._watermark, watermark.timestamp)
+        wm = self._watermark
+        for key, (left, right) in list(self._state.items()):
+            # Retention must mirror the OPPOSITE side's acceptance bound:
+            # a future right is accepted while rts - lower >= wm, i.e.
+            # rts >= wm + lower, and pairs a left when rts <= lts + upper
+            # — so a left stays live while lts + upper >= wm + lower
+            # (symmetric for rights).  Evicting at the tighter bound
+            # would drop elements whose match is still admissible.
+            left[:] = [(ts, v) for ts, v in left
+                       if ts + self.upper >= wm + self.lower]
+            right[:] = [(ts, v) for ts, v in right
+                        if ts - self.lower >= wm - self.upper]
+            if not left and not right:
+                del self._state[key]
+        self.output.broadcast_element(watermark)
+
+    def _operator_snapshot(self):
+        return {
+            "watermark": self._watermark,
+            "state": {k: (list(l), list(r)) for k, (l, r) in self._state.items()},
+        }
+
+    def _operator_restore(self, state):
+        self._watermark = state["watermark"]
+        self._state = {
+            k: (list(l), list(r)) for k, (l, r) in state["state"].items()
+        }
+
+    def _rescale_operator_state(self, states, mine):
+        from flink_tensorflow_tpu.core.event_time import _min_watermark
+
+        merged: typing.Dict[typing.Any, typing.Tuple[list, list]] = {}
+        for s in states:
+            if not s:
+                continue
+            for key, (l, r) in s["state"].items():
+                if mine(key):
+                    dst = merged.setdefault(key, ([], []))
+                    dst[0].extend(l)
+                    dst[1].extend(r)
+        return {"watermark": _min_watermark(states), "state": merged}

@@ -81,6 +81,12 @@ class Operator:
     def process_record(self, record: el.StreamRecord) -> None:
         raise NotImplementedError
 
+    def process_record_from(self, input_index: int, record: el.StreamRecord) -> None:
+        """Record dispatch carrying the logical input (edge) index —
+        two-input operators (connect/join) override this; single-input
+        operators ignore the index."""
+        self.process_record(record)
+
     def process_watermark(self, watermark: el.Watermark) -> None:
         self.output.broadcast_element(watermark)
 
@@ -288,6 +294,103 @@ class ProcessOperator(_FunctionOperator):
             if s:
                 timers.extend(tuple(t) for t in s["timers"])
         if timers and self.key_selector is None:
+            raise StateNotRescalable(
+                f"operator {self.name!r}: non-keyed timers are per-subtask"
+            )
+        return {"timers": [t for t in timers if mine(t[0])]}
+
+
+class CoMapOperator(_FunctionOperator):
+    """Two-input map: input 0 -> map1, input 1 -> map2."""
+
+    def process_record(self, record):  # pragma: no cover - indexed dispatch only
+        raise RuntimeError("two-input operator requires process_record_from")
+
+    def process_record_from(self, input_index, record):
+        f = self.function.map1 if input_index == 0 else self.function.map2
+        self.output.emit(f(record.value), record.timestamp)
+
+
+class CoFlatMapOperator(_FunctionOperator):
+    def process_record(self, record):  # pragma: no cover - indexed dispatch only
+        raise RuntimeError("two-input operator requires process_record_from")
+
+    def process_record_from(self, input_index, record):
+        f = self.function.flat_map1 if input_index == 0 else self.function.flat_map2
+        for out in f(record.value):
+            self.output.emit(out, record.timestamp)
+
+
+class CoProcessOperator(_FunctionOperator):
+    """Two-input process function; keyed when both key selectors are set
+    (both inputs must be partitioned by the SAME key space)."""
+
+    def __init__(self, name, function, key_selector1=None, key_selector2=None):
+        super().__init__(name, function)
+        if (key_selector1 is None) != (key_selector2 is None):
+            raise ValueError("connect: key both inputs or neither")
+        self.key_selector1 = key_selector1
+        self.key_selector2 = key_selector2
+        self._collector: typing.Optional[fn.Collector] = None
+        self._pctx: typing.Optional[fn.ProcessContext] = None
+        self._timers: typing.Dict[typing.Tuple[typing.Any, float], None] = {}
+
+    def open(self) -> None:
+        self._collector = fn.Collector(self.output.emit)
+        self._pctx = fn.ProcessContext(self)
+        super().open()
+
+    def get_value_state(self, descriptor):
+        return self.keyed_state.value_state(descriptor)
+
+    def register_timer(self, key, timestamp: float) -> None:
+        self._timers[(key, timestamp)] = None
+
+    def process_record(self, record):  # pragma: no cover - indexed dispatch only
+        raise RuntimeError("two-input operator requires process_record_from")
+
+    def process_record_from(self, input_index, record):
+        selector = self.key_selector1 if input_index == 0 else self.key_selector2
+        if selector is not None:
+            key = selector(record.value)
+            self.keyed_state.current_key = key
+            self._pctx.current_key = key
+        self._pctx.timestamp = record.timestamp
+        handler = (
+            self.function.process_element1 if input_index == 0
+            else self.function.process_element2
+        )
+        handler(record.value, self._pctx, self._collector)
+
+    def finish(self):
+        self.function.on_finish(self._collector)
+
+    def next_deadline(self):
+        if not self._timers:
+            return None
+        return min(ts for (_, ts) in self._timers)
+
+    def fire_due(self, now):
+        due = [(k, ts) for (k, ts) in self._timers if ts <= now]
+        for key, ts in sorted(due, key=lambda x: x[1]):
+            del self._timers[(key, ts)]
+            self.keyed_state.current_key = key
+            self._pctx.current_key = key
+            self._pctx.timestamp = ts
+            self.function.on_timer(ts, self._pctx, self._collector)
+
+    def _operator_snapshot(self):
+        return {"timers": list(self._timers.keys())}
+
+    def _operator_restore(self, state):
+        self._timers = {tuple(t): None for t in state["timers"]}
+
+    def _rescale_operator_state(self, states, mine):
+        timers = []
+        for s in states:
+            if s:
+                timers.extend(tuple(t) for t in s["timers"])
+        if timers and self.key_selector1 is None:
             raise StateNotRescalable(
                 f"operator {self.name!r}: non-keyed timers are per-subtask"
             )

@@ -52,6 +52,7 @@ class _Subtask:
         operator: Operator,
         gate: typing.Optional[InputGate],
         num_input_channels: int,
+        edge_of_channel: typing.Optional[typing.List[int]] = None,
     ):
         self.executor = executor
         self.t = transformation
@@ -59,6 +60,9 @@ class _Subtask:
         self.operator = operator
         self.gate = gate
         self.num_input_channels = num_input_channels
+        #: channel index -> logical input (edge) index, for two-input
+        #: operators (connect/join).
+        self.edge_of_channel = edge_of_channel or [0] * num_input_channels
         self.output: typing.Optional[Output] = None
         self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
         self._control_lock = threading.Lock()
@@ -141,7 +145,7 @@ class _Subtask:
                     continue
                 idx, element = item
                 if isinstance(element, el.StreamRecord):
-                    op.process_record(element)
+                    op.process_record_from(self.edge_of_channel[idx], element)
                 elif isinstance(element, el.CheckpointBarrier):
                     cid = element.checkpoint_id
                     seen = barrier_seen.setdefault(cid, set())
@@ -254,8 +258,10 @@ class LocalExecutor:
         # the upstream parallelism.
         channel_base: typing.Dict[typing.Tuple[int, int], int] = {}  # (down_id, edge_idx) -> base
         gate_size: typing.Dict[int, int] = {}
+        edge_of_channel: typing.Dict[int, typing.List[int]] = {}  # t.id -> per-channel edge idx
         for t in order:
             base = 0
+            channel_edges: typing.List[int] = []
             for edge_idx, edge in enumerate(t.inputs):
                 channel_base[(t.id, edge_idx)] = base
                 if isinstance(edge.partitioner, ForwardPartitioner):
@@ -264,10 +270,13 @@ class LocalExecutor:
                             f"forward edge {edge.upstream.name}->{t.name} requires equal "
                             f"parallelism ({edge.upstream.parallelism} vs {t.parallelism})"
                         )
-                    base += 1
+                    span = 1
                 else:
-                    base += edge.upstream.parallelism
+                    span = edge.upstream.parallelism
+                channel_edges.extend([edge_idx] * span)
+                base += span
             gate_size[t.id] = base
+            edge_of_channel[t.id] = channel_edges
 
         # Pass 2: instantiate subtasks and gates.
         for t in order:
@@ -279,7 +288,8 @@ class LocalExecutor:
                     gate = InputGate(gate_size[t.id], capacity=self.channel_capacity)
                     gates[(t.id, i)] = gate
                     self._gates.append(gate)
-                st = _Subtask(self, t, i, operator, gate, gate_size[t.id])
+                st = _Subtask(self, t, i, operator, gate, gate_size[t.id],
+                              edge_of_channel[t.id])
                 subtasks.append(st)
             by_transformation[t.id] = subtasks
 

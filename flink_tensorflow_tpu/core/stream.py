@@ -165,6 +165,19 @@ class DataStream:
     def union(self, *others: "DataStream") -> "UnionStream":
         return UnionStream(self.env, [self, *others])
 
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        """Pair two streams for two-input operators (CoMap/CoProcess):
+        ``s1.connect(s2).map(f)`` with ``f.map1``/``f.map2`` per input."""
+        if isinstance(other, KeyedStream):
+            raise TypeError("connect: key both inputs or neither — call "
+                            ".key_by(...) on this stream too")
+        return ConnectedStreams(self.env, self, other)
+
+    def join(self, other: "DataStream") -> "JoinBuilder":
+        """Window join builder (Flink style):
+        ``s1.join(s2).where(k1).equal_to(k2).window(size_s).apply(f)``."""
+        return JoinBuilder(self.env, self, other)
+
     # -- event time --------------------------------------------------------
     def assign_timestamps(
         self, ts_fn: typing.Callable[[typing.Any], float], *,
@@ -286,6 +299,26 @@ class KeyedStream:
         return SessionWindowedStream(self.env, self, gap_s,
                                      key_selector=self.key_selector)
 
+    def connect(self, other: "KeyedStream") -> "ConnectedStreams":
+        """Keyed connect: both inputs partitioned into the SAME key space;
+        the CoProcessFunction sees shared keyed state across inputs."""
+        if not isinstance(other, KeyedStream):
+            raise TypeError("keyed connect requires both streams keyed — "
+                            "call .key_by(...) on the other stream too")
+        return ConnectedStreams(
+            self.env, self, other,
+            key_selector1=self.key_selector, key_selector2=other.key_selector,
+        )
+
+    def interval_join(self, other: "KeyedStream", *, lower_s: float,
+                      upper_s: float) -> "IntervalJoinBuilder":
+        """Event-time interval join: pairs this stream's elements l with
+        the other's r when ``l.ts + lower_s <= r.ts <= l.ts + upper_s``.
+        ``left.interval_join(right, lower_s=-2, upper_s=2).apply(f)``."""
+        if not isinstance(other, KeyedStream):
+            raise TypeError("interval_join requires both streams keyed")
+        return IntervalJoinBuilder(self.env, self, other, lower_s, upper_s)
+
     def reduce(self, f: typing.Union["fn.ReduceFunction", typing.Callable], *,
                name="reduce", parallelism=None) -> DataStream:
         """Running per-key reduction; emits the updated accumulator per
@@ -400,5 +433,136 @@ class WindowedStream:
             lambda: WindowOperator(name, f, self.trigger, key_selector=self.key_selector),
             parallelism,
             inputs=[edge],
+        )
+        return DataStream(self.env, t)
+
+
+class ConnectedStreams:
+    """Two paired streams feeding one two-input operator.
+
+    Unkeyed: the two inputs are rebalanced/forwarded independently.
+    Keyed (via ``KeyedStream.connect``): both inputs hash into the same
+    key space, so keyed state is consistent across them.
+    """
+
+    def __init__(self, env, s1, s2, key_selector1=None, key_selector2=None):
+        self.env = env
+        self.s1 = s1
+        self.s2 = s2
+        self.key_selector1 = key_selector1
+        self.key_selector2 = key_selector2
+
+    def _edges(self, parallelism):
+        maxp = self.env.config.max_parallelism
+        if self.key_selector1 is not None:
+            return [
+                Edge(self.s1.transformation,
+                     HashPartitioner(self.key_selector1, maxp)),
+                Edge(self.s2.transformation,
+                     HashPartitioner(self.key_selector2, maxp)),
+            ]
+        return [self.s1._edge(parallelism), self.s2._edge(parallelism)]
+
+    def _add(self, name, factory, parallelism):
+        parallelism = parallelism or self.env.default_parallelism
+        t = self.env.graph.add(name, factory, parallelism,
+                               inputs=self._edges(parallelism))
+        return DataStream(self.env, t)
+
+    def map(self, f: "fn.CoMapFunction", *, name="co_map", parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.operators import CoMapOperator
+
+        return self._add(name, lambda: CoMapOperator(name, f), parallelism)
+
+    def flat_map(self, f: "fn.CoFlatMapFunction", *, name="co_flat_map",
+                 parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.operators import CoFlatMapOperator
+
+        return self._add(name, lambda: CoFlatMapOperator(name, f), parallelism)
+
+    def process(self, f: "fn.CoProcessFunction", *, name="co_process",
+                parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.operators import CoProcessOperator
+
+        return self._add(
+            name,
+            lambda: CoProcessOperator(name, f,
+                                      key_selector1=self.key_selector1,
+                                      key_selector2=self.key_selector2),
+            parallelism,
+        )
+
+
+class JoinBuilder:
+    """``s1.join(s2).where(k1).equal_to(k2).window(size_s).apply(f)``."""
+
+    def __init__(self, env, s1: DataStream, s2: DataStream):
+        self.env = env
+        self.s1 = s1
+        self.s2 = s2
+        self._key1 = None
+        self._key2 = None
+        self._size_s = None
+
+    def where(self, key_selector) -> "JoinBuilder":
+        self._key1 = key_selector
+        return self
+
+    def equal_to(self, key_selector) -> "JoinBuilder":
+        self._key2 = key_selector
+        return self
+
+    def window(self, size_s: float) -> "JoinBuilder":
+        self._size_s = size_s
+        return self
+
+    def apply(self, f, *, name="window_join", parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.joins import WindowJoinOperator, as_join_function
+
+        if self._key1 is None or self._key2 is None:
+            raise ValueError("join needs .where(k1).equal_to(k2)")
+        if self._size_s is None:
+            raise ValueError("join needs .window(size_s)")
+        func = as_join_function(f)
+        maxp = self.env.config.max_parallelism
+        parallelism = parallelism or self.env.default_parallelism
+        edges = [
+            Edge(self.s1.transformation, HashPartitioner(self._key1, maxp)),
+            Edge(self.s2.transformation, HashPartitioner(self._key2, maxp)),
+        ]
+        t = self.env.graph.add(
+            name,
+            lambda: WindowJoinOperator(name, func, self._size_s,
+                                       self._key1, self._key2),
+            parallelism,
+            inputs=edges,
+        )
+        return DataStream(self.env, t)
+
+
+class IntervalJoinBuilder:
+    """``left.interval_join(right, lower_s=.., upper_s=..).apply(f)``."""
+
+    def __init__(self, env, left: "KeyedStream", right: "KeyedStream",
+                 lower_s: float, upper_s: float):
+        self.env = env
+        self.left = left
+        self.right = right
+        self.lower_s = lower_s
+        self.upper_s = upper_s
+
+    def apply(self, f, *, name="interval_join", parallelism=None) -> DataStream:
+        from flink_tensorflow_tpu.core.joins import IntervalJoinOperator, as_join_function
+
+        func = as_join_function(f)
+        parallelism = parallelism or self.env.default_parallelism
+        t = self.env.graph.add(
+            name,
+            lambda: IntervalJoinOperator(
+                name, func, self.lower_s, self.upper_s,
+                self.left.key_selector, self.right.key_selector,
+            ),
+            parallelism,
+            inputs=[self.left._edge(), self.right._edge()],
         )
         return DataStream(self.env, t)

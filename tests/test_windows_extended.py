@@ -177,6 +177,22 @@ class TestSessionWindows:
         _, w = out[0]
         assert [r["t"] for r in w] == [0.0, 1.5, 3.0]  # timestamp order
 
+    def test_touching_sessions_merge(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # Records exactly gap apart: [0,2) and [2,4) TOUCH -> one session
+        # (Flink's inclusive intersects).
+        records = [{"t": 0.0}, {"t": 2.0}]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .session_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        assert len(out) == 1
+        assert [r["t"] for r in out[0][1]] == [0.0, 2.0]
+
     def test_late_record_still_merges_into_open_session(self):
         env = StreamExecutionEnvironment(parallelism=1)
         # After t=10,12 (gap 5 -> open session [10,17), wm=12), the
