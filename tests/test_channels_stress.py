@@ -1,0 +1,163 @@
+"""Channel-layer property/stress tests (SURVEY.md §5 "Race detection":
+property tests for the channel layer; VERDICT r1 §2 marked them missing).
+
+The contracts under test:
+- per-channel FIFO order survives concurrent multi-producer load,
+- bounded capacity gives backpressure (writers block, nothing is lost),
+- barrier stash/replay preserves per-channel order and loses nothing
+  under randomized block/unblock cycles,
+- close() unblocks stuck writers promptly.
+"""
+
+import random
+import threading
+import time
+
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core.channels import ChannelWriter, InputGate
+
+
+def _rec(v):
+    return el.StreamRecord(v, None)
+
+
+class TestMultiProducerFifo:
+    def test_per_channel_order_under_concurrency(self):
+        n_channels, per_channel = 8, 2000
+        gate = InputGate(n_channels, capacity=64)  # small: forces contention
+
+        def producer(idx):
+            w = ChannelWriter(gate, idx)
+            for i in range(per_channel):
+                w.write(_rec((idx, i)))
+
+        threads = [threading.Thread(target=producer, args=(c,)) for c in range(n_channels)]
+        for t in threads:
+            t.start()
+        seen = {c: [] for c in range(n_channels)}
+        total = n_channels * per_channel
+        got = 0
+        while got < total:
+            item = gate.poll(timeout=5.0)
+            assert item is not None, f"stalled after {got}/{total}"
+            idx, element = item
+            seen[idx].append(element.value[1])
+            got += 1
+        for t in threads:
+            t.join(timeout=5.0)
+        for c in range(n_channels):
+            # FIFO per channel: exactly 0..per_channel-1 in order.
+            assert seen[c] == list(range(per_channel))
+
+    def test_backpressure_blocks_writer_without_loss(self):
+        gate = InputGate(1, capacity=4)
+        w = ChannelWriter(gate, 0)
+        n = 200
+        done = threading.Event()
+
+        def producer():
+            for i in range(n):
+                w.write(_rec(i))
+            done.set()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.1)
+        # Capacity 4: the producer cannot have finished.
+        assert not done.is_set()
+        out = []
+        while len(out) < n:
+            item = gate.poll(timeout=5.0)
+            assert item is not None
+            out.append(item[1].value)
+        t.join(timeout=5.0)
+        assert out == list(range(n))
+
+
+class TestBarrierStashReplay:
+    def test_randomized_block_unblock_preserves_order(self):
+        """Property: under arbitrary block/unblock cycles, the reader
+        still observes every channel's elements exactly once, in
+        per-channel FIFO order, and never sees a blocked channel's
+        element while it is blocked."""
+        rng = random.Random(42)
+        n_channels, per_channel = 4, 500
+        gate = InputGate(n_channels, capacity=32)
+
+        def producer(idx):
+            w = ChannelWriter(gate, idx)
+            for i in range(per_channel):
+                w.write(_rec((idx, i)))
+
+        threads = [threading.Thread(target=producer, args=(c,)) for c in range(n_channels)]
+        for t in threads:
+            t.start()
+
+        seen = {c: [] for c in range(n_channels)}
+        blocked = set()
+        total = n_channels * per_channel
+        got = 0
+        while got < total:
+            # Randomly toggle alignment state, like barrier arrival does.
+            if rng.random() < 0.05 and len(blocked) < n_channels - 1:
+                c = rng.randrange(n_channels)
+                gate.block_channel(c)
+                blocked.add(c)
+            if blocked and rng.random() < 0.03:
+                gate.unblock_all()
+                blocked.clear()
+            # Short probe: a None here is the all-blocked case, not a
+            # stall — a long timeout would dead-wait on stashed data.
+            item = gate.poll(timeout=0.25)
+            if item is None:
+                # Every live channel blocked with data stashed: release.
+                gate.unblock_all()
+                blocked.clear()
+                continue
+            idx, element = item
+            assert idx not in blocked, "delivered from a blocked channel"
+            seen[idx].append(element.value[1])
+            got += 1
+        gate.unblock_all()
+        assert gate.poll(timeout=0.2) is None  # nothing left behind
+        for t in threads:
+            t.join(timeout=5.0)
+        for c in range(n_channels):
+            assert seen[c] == list(range(per_channel)), f"channel {c} disordered"
+
+    def test_stash_respects_reblock_between_cycles(self):
+        gate = InputGate(2, capacity=16)
+        w0, w1 = ChannelWriter(gate, 0), ChannelWriter(gate, 1)
+        gate.block_channel(0)
+        w0.write(_rec("a0"))
+        w1.write(_rec("b0"))
+        idx, e = gate.poll(timeout=1.0)
+        assert (idx, e.value) == (1, "b0")
+        # Replay then immediately re-block: the replayed element must be
+        # re-stashed, not delivered.
+        gate.unblock_all()
+        gate.block_channel(0)
+        assert gate.poll(timeout=0.2) is None
+        gate.unblock_all()
+        idx, e = gate.poll(timeout=1.0)
+        assert (idx, e.value) == (0, "a0")
+
+
+class TestClose:
+    def test_close_releases_blocked_writers(self):
+        gate = InputGate(1, capacity=1)
+        w = ChannelWriter(gate, 0)
+        w.write(_rec(0))  # fills capacity
+        finished = threading.Event()
+
+        def producer():
+            w.write(_rec(1))  # blocks on full queue
+            finished.set()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.1)
+        assert not finished.is_set()
+        gate.close()
+        t.join(timeout=2.0)
+        assert finished.is_set(), "close() must unblock writers"
