@@ -1,0 +1,81 @@
+"""Fixed-seed numerical goldens (SURVEY.md §4: "numerical golden tests
+per workload — fixed seed, tiny model, assert loss trajectory /
+logits").
+
+Rather than pinning magic constants (jaxlib upgrades would rot them),
+these goldens pin the property the constants would encode: the SAME
+seed and stream produce BIT-IDENTICAL results across independent runs —
+the determinism that makes replay-based exactly-once meaningful.
+"""
+
+import numpy as np
+import optax
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.functions import ModelWindowFunction, OnlineTrainFunction
+from flink_tensorflow_tpu.models import get_model_def
+from flink_tensorflow_tpu.tensors import BucketPolicy, RecordSchema, TensorValue, spec
+
+
+def _lenet_job():
+    import jax
+
+    mdef = get_model_def("lenet")
+    model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+    rng = np.random.RandomState(7)
+    records = [TensorValue({"image": rng.rand(28, 28, 1).astype(np.float32)},
+                           {"id": i}) for i in range(32)]
+    env = StreamExecutionEnvironment(parallelism=1)
+    out = (
+        env.from_collection(records, parallelism=1)
+        .count_window(8)
+        .apply(ModelWindowFunction(model, policy=BucketPolicy(fixed_batch=8)),
+               name="lenet", parallelism=1)
+        .sink_to_list()
+    )
+    env.execute("golden-lenet", timeout=120)
+    return np.stack([r["prob"] for r in sorted(out, key=lambda r: r.meta["id"])])
+
+
+def _widedeep_losses():
+    cfg = dict(hash_buckets=100, embed_dim=4, num_cat_slots=2,
+               num_dense=4, num_wide=8, hidden=(16,))
+    mdef = get_model_def("widedeep", **cfg)
+    schema = RecordSchema({
+        "wide": spec((cfg["num_wide"],)),
+        "dense": spec((cfg["num_dense"],)),
+        "cat": spec((cfg["num_cat_slots"],), np.int32),
+        "label": spec((), np.int32),
+    })
+    rng = np.random.RandomState(3)
+    records = []
+    for i in range(48):
+        records.append(TensorValue({
+            "wide": rng.rand(cfg["num_wide"]).astype(np.float32),
+            "dense": rng.rand(cfg["num_dense"]).astype(np.float32),
+            "cat": rng.randint(0, 100, (cfg["num_cat_slots"],)).astype(np.int32),
+            "label": np.int32(i % 2),
+        }, meta={"user": i % 4}))
+    env = StreamExecutionEnvironment(parallelism=1)
+    out = (
+        env.from_collection(records, parallelism=1)
+        .key_by(lambda r: r.meta["user"])
+        .process(OnlineTrainFunction(mdef, optax.adam(1e-2), train_schema=schema,
+                                     mini_batch=4, seed=11),
+                 name="train", parallelism=1)
+        .sink_to_list()
+    )
+    env.execute("golden-widedeep", timeout=120)
+    return np.asarray([float(r["loss"]) for r in out])
+
+
+class TestFixedSeedGoldens:
+    def test_lenet_inference_bit_identical_across_runs(self):
+        a, b = _lenet_job(), _lenet_job()
+        np.testing.assert_array_equal(a, b)
+
+    def test_widedeep_training_trajectory_bit_identical(self):
+        a, b = _widedeep_losses(), _widedeep_losses()
+        assert len(a) == len(b) == 12  # 48 records / mini_batch 4
+        np.testing.assert_array_equal(a, b)
+        assert np.isfinite(a).all()
