@@ -12,8 +12,10 @@ on a ``[B, 299, 299, 3]`` HBM-resident batch.
 
 Run:  python examples/inception_inference.py --records 512 --batch 32
       python examples/inception_inference.py --smoke --cpu   # CI-safe
+      python examples/inception_inference.py --bundle-dir /tmp/incep  # artifact path
 """
 
+import os
 import sys
 import time
 
@@ -22,7 +24,12 @@ from examples._common import base_parser, report, select_platform, synthetic_ima
 
 
 def main(argv=None):
-    args = base_parser(__doc__).parse_args(argv)
+    p = base_parser(__doc__)
+    p.add_argument("--bundle-dir", default=None,
+                   help="serve from a saved model bundle (exported on first "
+                        "run) — the reference's load-an-artifact deployment "
+                        "shape, instead of in-process init")
+    args = p.parse_args(argv)
     select_platform(args.cpu)
     if args.smoke:
         args.records, args.batch = 16, 8
@@ -31,12 +38,22 @@ def main(argv=None):
 
     from flink_tensorflow_tpu import StreamExecutionEnvironment
     from flink_tensorflow_tpu.functions import ModelWindowFunction
-    from flink_tensorflow_tpu.models import get_model_def
+    from flink_tensorflow_tpu.models import SavedModelLoader, get_model_def, save_bundle
     from flink_tensorflow_tpu.tensors import BucketPolicy
 
     num_classes = 10 if args.smoke else 1000
     mdef = get_model_def("inception_v3", num_classes=num_classes)
-    model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+    if args.bundle_dir:
+        # The reference's flagship job LOADS its model (frozen graph /
+        # SavedModel) rather than building it in-process (SURVEY.md §3.3).
+        # Export once, then every operator replica loads the bundle at
+        # open() — the artifact-deployment shape.
+        if not os.path.isdir(args.bundle_dir):
+            params = jax.jit(mdef.init_fn)(jax.random.key(0))
+            save_bundle(mdef, params, args.bundle_dir)
+        model = SavedModelLoader(args.bundle_dir)
+    else:
+        model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
     records = synthetic_images(args.records, 299)
 
     env = StreamExecutionEnvironment(parallelism=args.parallelism)

@@ -1,3 +1,4 @@
+from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
 from flink_tensorflow_tpu.io.sources import (
     CollectionSource,
     GeneratorSource,
@@ -5,4 +6,11 @@ from flink_tensorflow_tpu.io.sources import (
     ThrottledSource,
 )
 
-__all__ = ["CollectionSource", "GeneratorSource", "PacedSource", "ThrottledSource"]
+__all__ = [
+    "CollectionSource",
+    "GeneratorSource",
+    "PacedSource",
+    "RemoteSink",
+    "RemoteSource",
+    "ThrottledSource",
+]
