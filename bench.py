@@ -141,7 +141,9 @@ def bench_inception(args) -> dict:
     mid = len(arrivals) // 2
     half1 = (arrivals[mid] - arrivals[0]) or float("nan")
     half2 = (arrivals[-1] - arrivals[mid]) or float("nan")
-    rps_halves = (round(mid / half1, 2), round((len(arrivals) - mid) / half2, 2))
+    # arrivals[mid]..arrivals[-1] spans len-1-mid arriving records.
+    rps_halves = (round(mid / half1, 2),
+                  round((len(arrivals) - 1 - mid) / half2, 2))
 
     # --- decomposition (VERDICT r1 #2): where a batch's time goes --------
     m = job.metrics
@@ -268,7 +270,8 @@ def bench_inception(args) -> dict:
         # Exclude the end-of-input flush burst (the last pipeline-depth
         # windows complete together and inflate the rate).
         depth_records = 2 * args.lanes * ol_batch
-        cut = max(2 * ol_batch, len(cal_arrivals) - depth_records)
+        cut = min(len(cal_arrivals),
+                  max(2 * ol_batch, len(cal_arrivals) - depth_records))
         span = cal_arrivals[cut - 1] - cal_arrivals[0]
         service_rps = (cut - ol_batch) / span if span > 0 else float("nan")
         rate = max(args.rate_fraction * service_rps, 1.0)
@@ -495,7 +498,11 @@ def bench_widedeep(args) -> dict:
         .key_by(lambda r: r.meta["user"])
         .process(
             OnlineTrainFunction(mdef, optax.adam(1e-2), train_schema=schema,
-                                mini_batch=mini_batch),
+                                mini_batch=mini_batch,
+                                # Fuse K steps per dispatch: un-fused, the
+                                # per-dispatch round trip caps a remote-
+                                # attached chip at ~1/RTT steps/s.
+                                steps_per_dispatch=16),
             name="online_train",
         )
         .sink_to_callable(sink)
@@ -514,6 +521,7 @@ def bench_widedeep(args) -> dict:
         "records_per_sec": round(steps_per_s * mini_batch, 2),
         "records": records_n,
         "mini_batch": mini_batch,
+        "steps_per_dispatch": 16,
         "steps": steps,
         "loss_first": round(float(np.mean(losses[:k])), 4),
         "loss_last": round(float(np.mean(losses[-k:])), 4),

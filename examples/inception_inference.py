@@ -51,6 +51,10 @@ def main(argv=None):
         if not os.path.isdir(args.bundle_dir):
             params = jax.jit(mdef.init_fn)(jax.random.key(0))
             save_bundle(mdef, params, args.bundle_dir)
+        else:
+            print(f"serving EXISTING bundle {args.bundle_dir} as-is "
+                  "(its architecture config wins over this run's flags)",
+                  file=sys.stderr)
         model = SavedModelLoader(args.bundle_dir)
     else:
         model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))

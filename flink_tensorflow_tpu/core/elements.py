@@ -50,3 +50,17 @@ class EndOfPartition:
 
 
 StreamElement = typing.Union[StreamRecord, Watermark, CheckpointBarrier, EndOfPartition]
+
+
+@dataclasses.dataclass(slots=True, frozen=True)
+class SideOutput:
+    """Value wrapper routing a record to a named side output.
+
+    Operators that divert records (late data from event-time windows,
+    Flink's ``sideOutputLateData``) emit ``SideOutput(tag, value)`` on
+    their regular output; ``DataStream.side_output(tag)`` taps and
+    unwraps them, while the main stream filters them out.
+    """
+
+    tag: str
+    value: typing.Any

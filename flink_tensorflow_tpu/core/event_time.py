@@ -100,7 +100,8 @@ class EventTimeWindowOperator(_FunctionOperator):
     GLOBAL_KEY = "__subtask__"
 
     def __init__(self, name: str, function: fn.WindowFunction, size_s: float,
-                 key_selector=None, slide_s: typing.Optional[float] = None):
+                 key_selector=None, slide_s: typing.Optional[float] = None,
+                 late_tag: typing.Optional[str] = None):
         super().__init__(name, function)
         if size_s <= 0:
             raise ValueError(f"window size must be positive, got {size_s}")
@@ -109,6 +110,9 @@ class EventTimeWindowOperator(_FunctionOperator):
         self.size = float(size_s)
         self.slide = float(slide_s) if slide_s is not None else float(size_s)
         self.key_selector = key_selector
+        #: When set, records too late for EVERY window they'd belong to
+        #: are emitted as SideOutput(late_tag, value) instead of dropped.
+        self.late_tag = late_tag
         self._buffers: typing.Dict[typing.Tuple[typing.Any, float], WindowBuffer] = {}
         self._watermark = -math.inf
         self._collector: typing.Optional[fn.Collector] = None
@@ -143,14 +147,20 @@ class EventTimeWindowOperator(_FunctionOperator):
             )
         ts = record.timestamp
         key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
+        assigned = False
         for start, end in self._starts_for(ts):
             if end <= self._watermark:
-                continue  # that window already fired: late, dropped (Flink rule)
+                continue  # that window already fired: late (Flink rule)
+            assigned = True
             buf = self._buffers.get((key, start))
             if buf is None:
                 buf = WindowBuffer(window=TimeWindow(start, end))
                 self._buffers[(key, start)] = buf
             buf.add(record.value, ts)
+        if not assigned and self.late_tag is not None:
+            # Completely late (every window it belongs to already fired):
+            # divert to the side output instead of silent drop.
+            self.output.emit(el.SideOutput(self.late_tag, record.value), ts)
 
     def process_watermark(self, watermark: el.Watermark) -> None:
         self._watermark = max(self._watermark, watermark.timestamp)
@@ -222,12 +232,13 @@ class SessionWindowOperator(_FunctionOperator):
     GLOBAL_KEY = "__subtask__"
 
     def __init__(self, name: str, function: fn.WindowFunction, gap_s: float,
-                 key_selector=None):
+                 key_selector=None, late_tag: typing.Optional[str] = None):
         super().__init__(name, function)
         if gap_s <= 0:
             raise ValueError(f"session gap must be positive, got {gap_s}")
         self.gap = float(gap_s)
         self.key_selector = key_selector
+        self.late_tag = late_tag
         #: Per key: list of open sessions (WindowBuffer with TimeWindow
         #: whose end INCLUDES the gap).
         self._sessions: typing.Dict[typing.Any, typing.List[WindowBuffer]] = {}
@@ -255,6 +266,8 @@ class SessionWindowOperator(_FunctionOperator):
             # Late only if it can neither merge into a live session nor
             # survive alone (a merging assigner keeps an out-of-order
             # record whose bridged session is still open — Flink rule).
+            if self.late_tag is not None:
+                self.output.emit(el.SideOutput(self.late_tag, record.value), ts)
             return
         merged = WindowBuffer(window=TimeWindow(start, end))
         merged.add(record.value, ts)

@@ -165,6 +165,21 @@ class DataStream:
     def union(self, *others: "DataStream") -> "UnionStream":
         return UnionStream(self.env, [self, *others])
 
+    def side_output(self, tag: str) -> "DataStream":
+        """Tap a named side output (e.g. the late-data stream of an
+        event-time window applied with ``late_tag=...``) — Flink's
+        ``getSideOutput``.  Unwraps the SideOutput envelopes."""
+        from flink_tensorflow_tpu.core import elements as el
+
+        src_t = getattr(self, "_side_source", None) or self.transformation
+        src = DataStream(self.env, src_t)
+        return src.flat_map(
+            lambda v: [v.value]
+            if isinstance(v, el.SideOutput) and v.tag == tag else [],
+            name=f"side_output:{tag}",
+            parallelism=src_t.parallelism,
+        )
+
     def connect(self, other: "DataStream") -> "ConnectedStreams":
         """Pair two streams for two-input operators (CoMap/CoProcess):
         ``s1.connect(s2).map(f)`` with ``f.map1``/``f.map2`` per input."""
@@ -369,7 +384,11 @@ class EventTimeWindowedStream:
         self.slide_s = slide_s
         self.key_selector = key_selector
 
-    def apply(self, f: fn.WindowFunction, *, name="time_window", parallelism=None) -> DataStream:
+    def apply(self, f: fn.WindowFunction, *, name="time_window", parallelism=None,
+              late_tag: typing.Optional[str] = None) -> DataStream:
+        """``late_tag`` diverts completely-late records to a side output
+        (tap with ``result.side_output(late_tag)``) instead of dropping
+        them — Flink's ``sideOutputLateData``."""
         from flink_tensorflow_tpu.core.event_time import EventTimeWindowOperator
 
         parallelism = parallelism or self.env.default_parallelism
@@ -381,11 +400,12 @@ class EventTimeWindowedStream:
             name,
             lambda: EventTimeWindowOperator(name, f, self.size_s,
                                             key_selector=self.key_selector,
-                                            slide_s=self.slide_s),
+                                            slide_s=self.slide_s,
+                                            late_tag=late_tag),
             parallelism,
             inputs=[edge],
         )
-        return DataStream(self.env, t)
+        return _with_side_outputs(self.env, t, name, parallelism, late_tag)
 
 
 class SessionWindowedStream:
@@ -397,7 +417,8 @@ class SessionWindowedStream:
         self.gap_s = gap_s
         self.key_selector = key_selector
 
-    def apply(self, f: fn.WindowFunction, *, name="session_window", parallelism=None) -> DataStream:
+    def apply(self, f: fn.WindowFunction, *, name="session_window", parallelism=None,
+              late_tag: typing.Optional[str] = None) -> DataStream:
         from flink_tensorflow_tpu.core.event_time import SessionWindowOperator
 
         parallelism = parallelism or self.env.default_parallelism
@@ -408,11 +429,12 @@ class SessionWindowedStream:
         t = self.env.graph.add(
             name,
             lambda: SessionWindowOperator(name, f, self.gap_s,
-                                          key_selector=self.key_selector),
+                                          key_selector=self.key_selector,
+                                          late_tag=late_tag),
             parallelism,
             inputs=[edge],
         )
-        return DataStream(self.env, t)
+        return _with_side_outputs(self.env, t, name, parallelism, late_tag)
 
 
 class WindowedStream:
@@ -435,6 +457,23 @@ class WindowedStream:
             inputs=[edge],
         )
         return DataStream(self.env, t)
+
+
+def _with_side_outputs(env, raw_t, name, parallelism, late_tag):
+    """Wrap a side-output-producing transformation: the returned MAIN
+    stream filters the SideOutput envelopes out; ``side_output(tag)`` on
+    it taps the raw transformation."""
+    from flink_tensorflow_tpu.core import elements as el
+
+    stream = DataStream(env, raw_t)
+    if late_tag is None:
+        return stream
+    main = stream.flat_map(
+        lambda v: [] if isinstance(v, el.SideOutput) else [v],
+        name=f"{name}:main", parallelism=parallelism,
+    )
+    main._side_source = raw_t
+    return main
 
 
 class ConnectedStreams:

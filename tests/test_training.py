@@ -128,8 +128,11 @@ class TestOnlineTrain:
         out = _ListOut()
         for r in make_records(4, users=("a",)):
             f.process_element(r, _StubPCtx, out)
-        assert len(out.items) == 2
+        # Metric emission is pipelined (dispatch-and-go); the snapshot
+        # flushes everything in flight before capturing state.
         snap = f.snapshot_state()
+        assert len(out.items) == 2
+        assert [int(r["step"]) for r in out.items] == [1, 2]
 
         g = OnlineTrainFunction(
             widedeep_tiny(), optax.sgd(1e-2),
@@ -177,9 +180,11 @@ class TestOnlineTrain:
         out2 = _ListOut()
         for r in make_records(2, seed=1, users=("a",)):
             g.process_element(r, _StubPCtx, out2)
+        g.on_finish(out2)
         assert len(out2.items) == 1
-        assert np.isfinite(float(out2.items[0]["loss"]))
+        # Step numbering continues from the restored state (2 steps done).
         assert int(out2.items[0]["step"]) == 3
+        assert np.isfinite(float(out2.items[0]["loss"]))
 
 
 class TestDPTrainGang:
@@ -229,3 +234,40 @@ class TestDPTrainGang:
 
         with pytest.raises(JobFailure):
             env.execute(timeout=60)
+
+
+class TestFusedOnlineSteps:
+    """steps_per_dispatch fuses K SGD steps into one lax.scan dispatch;
+    the step sequence must match the unfused path (float rounding may
+    differ across executables) and partial chunks must flush."""
+
+    def _run(self, k, n=24):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_collection(make_records(n, users=("a",)))
+            .key_by(lambda r: r.meta["user"])
+            .process(
+                OnlineTrainFunction(
+                    widedeep_tiny(), optax.sgd(5e-2),
+                    train_schema=widedeep_train_schema(), mini_batch=2,
+                    steps_per_dispatch=k,
+                ),
+                name="train", parallelism=1,
+            )
+            .sink_to_list()
+        )
+        env.execute(timeout=300)
+        return out
+
+    def test_fused_matches_sequential(self):
+        a, b = self._run(1), self._run(4)
+        assert [int(r["step"]) for r in a] == [int(r["step"]) for r in b] \
+            == list(range(1, 13))
+        np.testing.assert_allclose([float(r["loss"]) for r in a],
+                                   [float(r["loss"]) for r in b], rtol=1e-5)
+
+    def test_partial_chunk_flushes_at_finish(self):
+        # 24 records / mini_batch 2 = 12 steps; with k=5 the last fused
+        # chunk holds only 2 staged steps — on_finish must run them.
+        out = self._run(5)
+        assert [int(r["step"]) for r in out] == list(range(1, 13))

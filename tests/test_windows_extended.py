@@ -213,6 +213,41 @@ class TestSessionWindows:
         assert len(out) == 1
         assert [r["t"] for r in out[0][1]] == [6.0, 10.0, 12.0]
 
+    def test_late_records_divert_to_side_output(self):
+        """Flink's sideOutputLateData: completely-late records reach the
+        tagged side stream instead of vanishing; the main stream never
+        sees the envelopes."""
+        env = StreamExecutionEnvironment(parallelism=1)
+        # t=0.5 arrives after the watermark (10) closed window [0,2).
+        records = [{"t": 1.0}, {"t": 10.0}, {"t": 0.5}]
+        result = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .time_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1, late_tag="late")
+        )
+        main = result.sink_to_list()
+        late = result.side_output("late").sink_to_list()
+        _run(env)
+        windows = [[r["t"] for r in w] for _, w in main]
+        assert windows == [[1.0], [10.0]]
+        assert [r["t"] for r in late] == [0.5]
+
+    def test_session_late_side_output(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = [{"t": 10.0}, {"t": 20.0}, {"t": 0.5}]  # 0.5 fully late
+        result = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .session_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1, late_tag="late")
+        )
+        main = result.sink_to_list()
+        late = result.side_output("late").sink_to_list()
+        _run(env)
+        assert sorted(tuple(r["t"] for r in w) for _, w in main) == [(10.0,), (20.0,)]
+        assert [r["t"] for r in late] == [0.5]
+
     def test_session_checkpoint_restore(self, tmp_path):
         import time as _time
 
