@@ -75,12 +75,25 @@ class ResNet(nn.Module):
 
 @register_model_def("resnet50")
 def build(num_classes: int = 1000, image_size: int = 224, width: int = 64,
-          stage_sizes: typing.Tuple[int, ...] = (3, 4, 6, 3)) -> ModelDef:
+          stage_sizes: typing.Tuple[int, ...] = (3, 4, 6, 3),
+          uint8_input: bool = False) -> ModelDef:
+    """``uint8_input=True``: records carry raw uint8 pixels and the model
+    normalizes on device (x/127.5 - 1) — 4x less host->HBM traffic per
+    batch (the dominant cost for DP training on bandwidth-limited
+    attachments), with the normalize fusing into the first conv."""
     module = ResNet(stage_sizes=tuple(stage_sizes), num_classes=num_classes, width=width)
-    schema = RecordSchema({"image": spec((image_size, image_size, 3), np.float32)})
+    in_dtype = np.uint8 if uint8_input else np.float32
+    schema = RecordSchema({"image": spec((image_size, image_size, 3), in_dtype)})
+
+    def _prep(x):
+        if uint8_input:
+            from flink_tensorflow_tpu.ops.preprocessing import inception_normalize
+
+            return inception_normalize(x)
+        return x
 
     def serve(variables, inputs):
-        logits = module.apply(variables, inputs["image"], train=False)
+        logits = module.apply(variables, _prep(inputs["image"]), train=False)
         return {
             "logits": logits,
             "label": jnp.argmax(logits, axis=-1).astype(jnp.int32),
@@ -96,7 +109,7 @@ def build(num_classes: int = 1000, image_size: int = 224, width: int = 64,
         from flink_tensorflow_tpu.models.zoo._common import weighted_metrics
 
         logits, new_state = module.apply(
-            variables, batch["image"], train=True, mutable=["batch_stats"],
+            variables, _prep(batch["image"]), train=True, mutable=["batch_stats"],
         )
         labels = batch["label"]
         per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
@@ -116,7 +129,7 @@ def build(num_classes: int = 1000, image_size: int = 224, width: int = 64,
     return ModelDef(
         architecture="resnet50",
         config={"num_classes": num_classes, "image_size": image_size, "width": width,
-                "stage_sizes": list(stage_sizes)},
+                "stage_sizes": list(stage_sizes), "uint8_input": uint8_input},
         module=module,
         input_schema=schema,
         methods=methods,
