@@ -91,6 +91,46 @@ class TestConnect:
         # and at least the state plumbing must not crash.
         assert sorted(v if v < 10 else v // 10 for v in got["a"]) == [1, 2, 3]
 
+    def test_broadcast_control_reaches_every_subtask(self):
+        """The broadcast-state pattern: a control stream broadcast to ALL
+        subtasks of a two-input operator, updating per-subtask function
+        state that the (rebalanced) data stream reads."""
+        import threading
+
+        seen_controls = []
+        lock = threading.Lock()
+
+        class Gate(fn.CoProcessFunction):
+            def open(self, ctx):
+                self._factor = 1
+                self._subtask = ctx.subtask_index
+
+            def process_element1(self, value, ctx, out):
+                out.collect(value * self._factor)
+
+            def process_element2(self, value, ctx, out):
+                self._factor = value
+                with lock:
+                    seen_controls.append(self._subtask)
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.source_throttle_s = 0.01  # let the broadcast land first
+        data = env.from_collection(list(range(1, 9)), parallelism=1)
+        control = env.from_collection([100], parallelism=1)
+        out = (
+            data.rebalance()
+            .connect(control.broadcast())
+            .process(Gate(), parallelism=3)
+            .sink_to_list()
+        )
+        env.execute("broadcast-state", timeout=60)
+        # Every subtask received the broadcast control record...
+        assert sorted(seen_controls) == [0, 1, 2]
+        # ...and each data record was scaled by whichever factor its
+        # subtask had at processing time (all = 100 once control landed).
+        assert len(out) == 8
+        assert all(v % 100 == 0 or v < 9 for v in out)
+
     def test_unkeyed_mixed_with_keyed_rejected(self):
         env = StreamExecutionEnvironment(parallelism=1)
         s1 = env.from_collection([1], parallelism=1).key_by(lambda v: v)
