@@ -37,6 +37,10 @@ from flink_tensorflow_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_sharded,
 )
+from flink_tensorflow_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -62,4 +66,6 @@ __all__ = [
     "ring_attention_sharded",
     "shard_batch",
     "spans_processes",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -125,3 +125,42 @@ class TestRingAttention:
         want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
         got = ring_attention(mesh, q, k, v, impl=impl)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism — the second long-context strategy
+    (parallel/ulysses.py); must agree with unsharded attention and with
+    the ring on identical inputs."""
+
+    @pytest.mark.parametrize("impl", ["flash", "einsum"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal, impl):
+        from flink_tensorflow_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh({"seq": 8})
+        rng = np.random.RandomState(4)
+        b, t, h, d = 2, 64, 8, 16  # heads divisible by seq size
+        q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+        want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+        got = ulysses_attention(mesh, q, k, v, causal=causal, impl=impl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_seq_with_data_axis(self):
+        from flink_tensorflow_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh({"data": 2, "seq": 4})
+        rng = np.random.RandomState(5)
+        b, t, h, d = 4, 32, 4, 8
+        q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+        want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        got = ulysses_attention(mesh, q, k, v, impl="einsum")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_indivisible_heads_rejected(self):
+        from flink_tensorflow_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh({"seq": 8})
+        q = np.zeros((1, 16, 6, 8), np.float32)  # 6 heads, 8 devices
+        with pytest.raises(Exception, match="divisible"):
+            ulysses_attention(mesh, q, q, q, impl="einsum")
