@@ -5,9 +5,14 @@ Default run (``python bench.py``) measures the north-star metric
 per-record latency through the full path — source -> count-window
 micro-batch -> one jitted bf16 forward per window on HBM-resident
 batches -> sink.  It prints ONE JSON line; the closed-loop throughput
-measurement is followed by an OPEN-LOOP pass (Poisson arrivals at ~70%
-of measured capacity via PacedSource) whose p50/p99 are the service
-latency numbers — closed-loop latency is queueing artifact.
+measurement is followed by an OPEN-LOOP pass (Poisson arrivals at half
+the freshly CALIBRATED service capacity, via PacedSource) whose p50/p99
+are the service latency numbers — closed-loop latency is queueing
+artifact.  The tunnel to the bench chip is token-bucket throttled
+(measured: ~60 rec/s burst decaying to ~21 sustained within one run,
+and minute-scale bandwidth swings of 3-22 MB/s between runs), so the
+JSON carries first/second-half rates and a per-batch decomposition to
+make each measurement interpretable.
 
 ``--workload {inception,mnist,bilstm,widedeep,resnet,all}`` benches the
 other four BASELINE.json configs (one JSON line each): MNIST LeNet
