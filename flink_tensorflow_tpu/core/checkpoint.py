@@ -137,6 +137,9 @@ class CheckpointCoordinator:
             from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
             write_checkpoint(self.checkpoint_dir, cid, pending.snapshots)
+        # Durable (or in-memory-complete): fire the commit signal for
+        # two-phase sinks.  Durability-before-notify is the 2PC order.
+        self.executor.notify_checkpoint_complete(cid)
         return pending.snapshots
 
     def begin_source_checkpoint(self, checkpoint_id: int) -> bool:
@@ -164,6 +167,7 @@ class CheckpointCoordinator:
         checkpoints are durable before the job reports done."""
         self._completed.append(pending.checkpoint_id)
         if self.checkpoint_dir is None:
+            self.executor.notify_checkpoint_complete(pending.checkpoint_id)
             return
 
         def persist():
@@ -179,6 +183,8 @@ class CheckpointCoordinator:
                     "persisting checkpoint %d failed", pending.checkpoint_id,
                     exc_info=True,
                 )
+                return  # NOT durable: the 2PC commit signal must not fire
+            self.executor.notify_checkpoint_complete(pending.checkpoint_id)
 
         with self._lock:
             if self._persist_pool is None:

@@ -59,6 +59,10 @@ class JobHandle:
 
     def cancel(self) -> None:
         self.executor.cancel()
+        # COMPLETED checkpoints may still be persisting on the async
+        # writer; they are valid restore points, so cancel must not
+        # abandon them (a caller typically restores right after).
+        self.executor.coordinator.wait_for_persistence(60.0)
 
     @property
     def metrics(self) -> MetricRegistry:

@@ -102,19 +102,27 @@ class Operator:
         """Called by the subtask loop when ``next_deadline`` has passed."""
 
     # -- snapshot protocol ----------------------------------------------
-    def snapshot(self) -> typing.Dict[str, typing.Any]:
+    def snapshot(self, checkpoint_id: typing.Optional[int] = None) -> typing.Dict[str, typing.Any]:
+        """``checkpoint_id`` is the id this snapshot belongs to (None for
+        the job-end final snapshot) — two-phase-commit sinks bind their
+        staged output to it."""
         return {
             "keyed": self.keyed_state.snapshot(),
-            "function": self._function_snapshot(),
+            "function": self._function_snapshot(checkpoint_id),
             "operator": self._operator_snapshot(),
         }
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:  # noqa: B027
+        """Checkpoint ``checkpoint_id`` is complete AND durable — the
+        commit signal for two-phase sinks (Flink's CheckpointListener).
+        Delivered on the subtask thread (single-writer contract)."""
 
     def restore(self, snap: typing.Dict[str, typing.Any]) -> None:
         self.keyed_state.restore(snap["keyed"])
         self._function_restore(snap["function"])
         self._operator_restore(snap["operator"])
 
-    def _function_snapshot(self) -> typing.Any:
+    def _function_snapshot(self, checkpoint_id: typing.Optional[int] = None) -> typing.Any:
         return None
 
     def _function_restore(self, state: typing.Any) -> None:
@@ -196,14 +204,22 @@ class _FunctionOperator(Operator):
         if isinstance(self.function, fn.RichFunction):
             self.function.close()
 
-    def _function_snapshot(self):
+    def _function_snapshot(self, checkpoint_id=None):
         if isinstance(self.function, fn.RichFunction):
+            hook = getattr(self.function, "snapshot_state_for_checkpoint", None)
+            if hook is not None:
+                return hook(checkpoint_id)
             return self.function.snapshot_state()
         return None
 
     def _function_restore(self, state):
         if state is not None and isinstance(self.function, fn.RichFunction):
             self.function.restore_state(state)
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        hook = getattr(self.function, "notify_checkpoint_complete", None)
+        if hook is not None:
+            hook(checkpoint_id)
 
     def _rescale_function_state(self, states, mine):
         if all(s is None for s in states):
@@ -547,6 +563,13 @@ class SinkOperator(_FunctionOperator):
 
     def process_watermark(self, watermark):
         pass  # terminal
+
+    def finish(self):
+        # Transactional sinks commit their tail on clean end-of-input
+        # (close() alone must stay cancel-safe and commit nothing).
+        hook = getattr(self.function, "finish", None)
+        if hook is not None:
+            hook()
 
 
 class SourceOperator(_FunctionOperator):

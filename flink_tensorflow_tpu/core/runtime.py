@@ -66,6 +66,9 @@ class _Subtask:
         self.output: typing.Optional[Output] = None
         self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
         self._control_lock = threading.Lock()
+        #: Completed-and-durable checkpoint ids awaiting delivery to the
+        #: operator on ITS thread (single-writer contract; Flink mailbox).
+        self._notifications: "typing.List[int]" = []
         self.thread: typing.Optional[threading.Thread] = None
         self.finished = threading.Event()
 
@@ -83,6 +86,16 @@ class _Subtask:
             pending, self.control = self.control, []
         return pending
 
+    def add_notification(self, checkpoint_id: int) -> None:
+        with self._control_lock:
+            self._notifications.append(checkpoint_id)
+
+    def _deliver_notifications(self) -> None:
+        with self._control_lock:
+            pending, self._notifications = self._notifications, []
+        for cid in pending:
+            self.operator.notify_checkpoint_complete(cid)
+
     # --- thread bodies ---------------------------------------------------
     def run_source(self) -> None:
         op = typing.cast(SourceOperator, self.operator)
@@ -93,6 +106,7 @@ class _Subtask:
             for value in op.iterate():
                 if self.executor.cancelled.is_set():
                     break
+                self._deliver_notifications()
                 for cid in self._drain_control():
                     self._snapshot_and_ack(cid)
                     self.output.broadcast_element(el.CheckpointBarrier(cid))
@@ -138,6 +152,7 @@ class _Subtask:
                 now = time.monotonic()
                 timeout = _IDLE_POLL_S if deadline is None else max(0.0, min(deadline - now, _IDLE_POLL_S))
                 item = gate.poll(timeout=timeout)
+                self._deliver_notifications()
                 now = time.monotonic()
                 if deadline is not None and now >= deadline:
                     op.fire_due(now)
@@ -188,7 +203,7 @@ class _Subtask:
             self.executor.subtask_finished(self)
 
     def _snapshot_and_ack(self, checkpoint_id: int) -> None:
-        snapshot = self.operator.snapshot()
+        snapshot = self.operator.snapshot(checkpoint_id)
         self.executor.coordinator.ack(checkpoint_id, self.t.name, self.index, snapshot)
 
 
@@ -437,6 +452,12 @@ class LocalExecutor:
         for gate in self._gates:
             gate.close()
         self.coordinator.cancel_pending()
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """Fan a durable-checkpoint notification out to every subtask
+        (delivered on each subtask's own thread)."""
+        for st in self.subtasks:
+            st.add_notification(checkpoint_id)
 
     def subtask_finished(self, subtask: _Subtask) -> None:
         self.coordinator.subtask_finished(subtask)

@@ -1,3 +1,11 @@
+from flink_tensorflow_tpu.io.files import (
+    ExactlyOnceRecordFileSink,
+    RecordFileSource,
+    committed_files,
+    read_committed,
+    read_record_file,
+    write_record_file,
+)
 from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
 from flink_tensorflow_tpu.io.sources import (
     CollectionSource,
@@ -8,9 +16,15 @@ from flink_tensorflow_tpu.io.sources import (
 
 __all__ = [
     "CollectionSource",
+    "ExactlyOnceRecordFileSink",
     "GeneratorSource",
     "PacedSource",
+    "RecordFileSource",
     "RemoteSink",
     "RemoteSource",
     "ThrottledSource",
+    "committed_files",
+    "read_committed",
+    "read_record_file",
+    "write_record_file",
 ]

@@ -1,0 +1,251 @@
+"""File IO — replayable record-file sources and an exactly-once sink.
+
+The record plane's frame codec (tensors/serde.py, the same length-
+prefixed format the remote plane ships) doubles as the on-disk format:
+a record file is a sequence of frames, so files produced by the sink are
+readable by the source and vice versa.
+
+``ExactlyOnceRecordFileSink`` closes the at-least-once caveat ordinary
+sinks carry (replayed records re-emit after a restore): it is a
+two-phase-commit sink in the Flink ``TwoPhaseCommitSinkFunction`` mold —
+records stage into ``*.inprogress`` transaction files, each checkpoint
+barrier closes the current transaction and BINDS it to that checkpoint
+id (phase 1), and the runtime's checkpoint-complete notification —
+which fires only after the checkpoint is durable — promotes bound files
+to their final names (phase 2).  A crash between barrier and commit
+leaves only ``.inprogress`` files, which the restore path promotes (if
+bound to the restored checkpoint or earlier) or deletes (post-snapshot
+strays whose records will replay).  Readers that only consume promoted
+files therefore see every record exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import typing
+
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.tensors.serde import decode_record, encode_record
+from flink_tensorflow_tpu.tensors.value import TensorValue
+
+_LEN = struct.Struct("<Q")
+_STAGING_SUFFIX = ".inprogress"
+
+
+def write_record_file(path: str, records: typing.Iterable[TensorValue]) -> int:
+    """Write records as a frame file (helper for tests/data prep)."""
+    n = 0
+    with open(path, "wb") as f:
+        for r in records:
+            payload = encode_record(r)
+            f.write(_LEN.pack(len(payload)) + payload)
+            n += 1
+    return n
+
+
+def iter_record_frames(path: str) -> typing.Iterator[bytes]:
+    """Stream a frame file's raw payloads (one record in memory at a
+    time; callers that skip records avoid even decoding them)."""
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_LEN.size)
+            if not head:
+                return
+            if len(head) < _LEN.size:
+                raise IOError(f"{path}: truncated frame header")
+            (length,) = _LEN.unpack(head)
+            payload = f.read(length)
+            if len(payload) < length:
+                raise IOError(f"{path}: truncated frame body")
+            yield payload
+
+
+def read_record_file(path: str) -> typing.List[TensorValue]:
+    return [decode_record(p) for p in iter_record_frames(path)]
+
+
+class RecordFileSource(fn.SourceFunction):
+    """Bounded, replayable source over one or more frame files.
+
+    With parallelism N, subtask i emits records i, i+N, ... of the
+    concatenated files (same striding contract as CollectionSource, so
+    offsets restore exactly)."""
+
+    def __init__(self, paths: typing.Union[str, typing.Sequence[str]]):
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self._subtask = 0
+        self._parallelism = 1
+
+    def clone(self):
+        import copy
+
+        return copy.copy(self)
+
+    def open(self, ctx):
+        self._subtask = ctx.subtask_index
+        self._parallelism = ctx.parallelism
+
+    def run(self):
+        i = 0
+        for path in self.paths:
+            for payload in iter_record_frames(path):
+                # Stream + stride: one frame in memory, and frames owned
+                # by other subtasks are never even decoded.
+                if i % self._parallelism == self._subtask:
+                    yield decode_record(payload)
+                i += 1
+
+
+class ExactlyOnceRecordFileSink(fn.SinkFunction):
+    """Two-phase-commit frame-file sink (see module docstring).
+
+    Output layout per subtask: ``part-{subtask:03d}-{txn:06d}`` final
+    files; the in-flight transaction is the same name +
+    ``.inprogress``.  Use :func:`committed_files` /
+    :func:`read_committed` to consume only exactly-once output.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._subtask = 0
+        self._txn = 0  # next transaction number
+        self._file = None
+        self._records_in_txn = 0
+        #: txns closed at a barrier, keyed by the checkpoint id they
+        #: await: {checkpoint_id: [txn, ...]}.
+        self._bound: typing.Dict[int, typing.List[int]] = {}
+        self._restored: typing.Optional[dict] = None
+
+    def clone(self):
+        import copy
+
+        dup = copy.copy(self)
+        dup._file = None
+        dup._bound = {}
+        return dup
+
+    # -- paths -------------------------------------------------------------
+    def _final(self, txn: int) -> str:
+        return os.path.join(self.directory, f"part-{self._subtask:03d}-{txn:06d}")
+
+    def _staging(self, txn: int) -> str:
+        return self._final(txn) + _STAGING_SUFFIX
+
+    # -- lifecycle -----------------------------------------------------------
+    def open(self, ctx) -> None:
+        self._subtask = ctx.subtask_index
+        os.makedirs(self.directory, exist_ok=True)
+        if self._restored is not None:
+            self._txn = self._restored["txn"]
+            # Transactions bound to the restored checkpoint (or earlier)
+            # are covered by a DURABLE checkpoint — commit them now; their
+            # notify may have been lost in the crash.
+            for cid, txns in self._restored["bound"].items():
+                for txn in txns:
+                    self._promote(txn)
+            self._restored = None
+        # Retract everything at-or-after the restore point — staged AND
+        # committed: those records will REPLAY, so keeping either form
+        # would duplicate.  Committed files past the restored txn counter
+        # exist when restoring an EARLIER-than-latest checkpoint (the
+        # multi-host latest-common-checkpoint case): the rewind revokes
+        # those later commits.  (On a fresh run this also clears
+        # leftovers from a previous crashed attempt of the directory.)
+        prefix = f"part-{self._subtask:03d}-"
+        for name in os.listdir(self.directory):
+            if not name.startswith(prefix):
+                continue
+            stem = name[len(prefix):]
+            if stem.endswith(_STAGING_SUFFIX):
+                stem = stem[:-len(_STAGING_SUFFIX)]
+            try:
+                txn = int(stem)
+            except ValueError:
+                continue
+            if txn >= self._txn:
+                os.unlink(os.path.join(self.directory, name))
+
+    def invoke(self, value) -> None:
+        if not isinstance(value, TensorValue):
+            raise TypeError("ExactlyOnceRecordFileSink carries TensorValue records")
+        if self._file is None:
+            self._file = open(self._staging(self._txn), "wb")
+            self._records_in_txn = 0
+        payload = encode_record(value)
+        self._file.write(_LEN.pack(len(payload)) + payload)
+        self._records_in_txn += 1
+
+    # -- two-phase commit ----------------------------------------------------
+    def _close_txn(self, on_nonempty: typing.Callable[[int], None]) -> None:
+        """Flush+fsync+close the open transaction; a non-empty one is
+        handed to ``on_nonempty(txn)`` (bind or promote), an empty one is
+        unlinked.  No-op with no open transaction."""
+        if self._file is None:
+            return
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._file.close()
+        self._file = None
+        if self._records_in_txn:
+            on_nonempty(self._txn)
+        else:
+            os.unlink(self._staging(self._txn))
+        self._txn += 1
+
+    def snapshot_state_for_checkpoint(self, checkpoint_id) -> dict:
+        """Phase 1: close the open transaction, fsync it, bind it to this
+        checkpoint.  The snapshot records the binding so a crash before
+        the commit signal can still promote after restore."""
+        self._close_txn(
+            lambda txn: self._bound.setdefault(checkpoint_id, []).append(txn)
+        )
+        return {"txn": self._txn,
+                "bound": {c: list(t) for c, t in self._bound.items()}}
+
+    def restore_state(self, state) -> None:
+        self._restored = state
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """Phase 2: the checkpoint is durable — promote everything bound
+        to it (and to any earlier id, in case a notification was missed)."""
+        for cid in sorted(c for c in self._bound if c <= checkpoint_id):
+            for txn in self._bound.pop(cid):
+                self._promote(txn)
+
+    def _promote(self, txn: int) -> None:
+        staging = self._staging(txn)
+        if os.path.exists(staging):
+            os.replace(staging, self._final(txn))
+        # else: already promoted (idempotent commit)
+
+    def finish(self) -> None:
+        """Clean end of a bounded stream: everything staged is final —
+        there is no post-barrier replay left that could duplicate it."""
+        self._close_txn(self._promote)
+        for cid in list(self._bound):
+            for txn in self._bound.pop(cid):
+                self._promote(txn)
+
+    def close(self) -> None:
+        # Cancel-safe: close the handle, promote NOTHING — an uncommitted
+        # transaction's records will replay after restore.
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def committed_files(directory: str) -> typing.List[str]:
+    """All promoted (exactly-once) part files, sorted."""
+    return sorted(
+        os.path.join(directory, name)
+        for name in os.listdir(directory)
+        if name.startswith("part-") and not name.endswith(_STAGING_SUFFIX)
+    )
+
+
+def read_committed(directory: str) -> typing.List[TensorValue]:
+    out = []
+    for path in committed_files(directory):
+        out.extend(read_record_file(path))
+    return out
