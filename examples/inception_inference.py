@@ -29,6 +29,10 @@ def main(argv=None):
                    help="serve from a saved model bundle (exported on first "
                         "run) — the reference's load-an-artifact deployment "
                         "shape, instead of in-process init")
+    p.add_argument("--output-dir", default=None,
+                   help="also write results through the exactly-once "
+                        "two-phase-commit file sink (committed on durable "
+                        "checkpoints)")
     args = p.parse_args(argv)
     select_platform(args.cpu)
     if args.smoke:
@@ -61,7 +65,12 @@ def main(argv=None):
     records = synthetic_images(args.records, 299)
 
     env = StreamExecutionEnvironment(parallelism=args.parallelism)
-    results = (
+    if args.output_dir:
+        # Deterministic barriers + the 2PC sink: committed output files
+        # hold each result exactly once even across failover.
+        env.enable_checkpointing(args.output_dir + ".chk",
+                                 every_n_records=4 * args.batch)
+    labeled = (
         env.from_collection(records, parallelism=1)
         .rebalance()
         .count_window(args.batch, timeout_s=0.05)
@@ -74,14 +83,24 @@ def main(argv=None):
             name="inception",
             parallelism=args.parallelism,
         )
-        .sink_to_list()
     )
+    results = labeled.sink_to_list()
+    if args.output_dir:
+        from flink_tensorflow_tpu.io import ExactlyOnceRecordFileSink
+
+        labeled.add_sink(ExactlyOnceRecordFileSink(args.output_dir),
+                         name="committed_results", parallelism=args.parallelism)
     t0 = time.time()
     job = env.execute("inception-v3-labeling", timeout=3600)
     assert len(results) == args.records, (len(results), args.records)
     labels = [int(r["label"]) for r in results[:5]]
+    extra = {"sample_labels": labels}
+    if args.output_dir:
+        from flink_tensorflow_tpu.io import read_committed
+
+        extra["committed_records"] = len(read_committed(args.output_dir))
     return report("inception_v3_streaming_inference", job.metrics, t0,
-                  args.records, {"sample_labels": labels})
+                  args.records, extra)
 
 
 if __name__ == "__main__":

@@ -89,14 +89,24 @@ class TestExactlyOnceSink:
         env.source_throttle_s = 0.002
         self._build(env, records, out_dir)
         h = env.execute_async("sink-crash")
-        time.sleep(0.4)  # a couple of checkpoints in, mid-transaction
+        # Wait for at least one DURABLE checkpoint (slow machines), then
+        # crash mid-transaction.
+        from flink_tensorflow_tpu.checkpoint.store import checkpoint_ids
+
+        deadline = time.monotonic() + 30
+        while not checkpoint_ids(chk) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.1)
         h.cancel()  # crash: close() commits nothing
 
         committed_before = read_committed(out_dir)
         ids_before = [r.meta["id"] for r in committed_before]
-        # Only whole committed transactions, no duplicates.
+        # Only whole committed transactions, no duplicates.  (Zero is
+        # legitimate: the commit signal may not have reached the sink's
+        # thread before the crash — those transactions stay staged and
+        # get promoted on restore.)
         assert len(ids_before) == len(set(ids_before))
-        assert 0 < len(ids_before) < 400
+        assert len(ids_before) < 400
 
         env2 = StreamExecutionEnvironment(parallelism=1)
         env2.enable_checkpointing(chk, every_n_records=50)
