@@ -105,6 +105,15 @@ class CheckpointCoordinator:
         finally:
             self._trigger_lock.release()
 
+    def _with_job_meta(self, snapshots):
+        """Persisted checkpoints pin the key-group count: restoring under
+        a different max_parallelism would silently orphan keyed state
+        (the hash routing changes; Flink pins maxParallelism the same way)."""
+        return {
+            **snapshots,
+            "__job__": {0: {"max_parallelism": self.executor.max_parallelism}},
+        }
+
     def _seed_finished(self, pending: _PendingCheckpoint) -> None:
         """Subtasks already finished ack immediately with their final state
         (caller holds the lock)."""
@@ -136,7 +145,7 @@ class CheckpointCoordinator:
         if self.checkpoint_dir is not None:
             from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
-            write_checkpoint(self.checkpoint_dir, cid, pending.snapshots)
+            write_checkpoint(self.checkpoint_dir, cid, self._with_job_meta(pending.snapshots))
         # Durable (or in-memory-complete): fire the commit signal for
         # two-phase sinks.  Durability-before-notify is the 2PC order.
         self.executor.notify_checkpoint_complete(cid)
@@ -175,7 +184,7 @@ class CheckpointCoordinator:
 
             try:
                 write_checkpoint(self.checkpoint_dir, pending.checkpoint_id,
-                                 pending.snapshots)
+                                 self._with_job_meta(pending.snapshots))
             except Exception:  # pragma: no cover - disk trouble
                 import logging
 

@@ -148,7 +148,9 @@ class EventTimeWindowOperator(_FunctionOperator):
         ts = record.timestamp
         key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
         assigned = False
+        covered = False
         for start, end in self._starts_for(ts):
+            covered = True
             if end <= self._watermark:
                 continue  # that window already fired: late (Flink rule)
             assigned = True
@@ -157,9 +159,11 @@ class EventTimeWindowOperator(_FunctionOperator):
                 buf = WindowBuffer(window=TimeWindow(start, end))
                 self._buffers[(key, start)] = buf
             buf.add(record.value, ts)
-        if not assigned and self.late_tag is not None:
+        if covered and not assigned and self.late_tag is not None:
             # Completely late (every window it belongs to already fired):
-            # divert to the side output instead of silent drop.
+            # divert to the side output instead of silent drop.  A record
+            # in a hopping GAP (slide > size) belongs to no window at all
+            # — dropped by definition, never "late".
             self.output.emit(el.SideOutput(self.late_tag, record.value), ts)
 
     def process_watermark(self, watermark: el.Watermark) -> None:

@@ -105,10 +105,18 @@ class Operator:
     def snapshot(self, checkpoint_id: typing.Optional[int] = None) -> typing.Dict[str, typing.Any]:
         """``checkpoint_id`` is the id this snapshot belongs to (None for
         the job-end final snapshot) — two-phase-commit sinks bind their
-        staged output to it."""
+        staged output to it.
+
+        The FUNCTION hook runs FIRST: functions flush in-flight work
+        there (pipelined model batches, staged fused training steps),
+        and those flushes may update keyed state — capturing keyed
+        tables earlier would checkpoint a state missing steps whose
+        source records precede the barrier (silent loss on restore).
+        """
+        function = self._function_snapshot(checkpoint_id)
         return {
             "keyed": self.keyed_state.snapshot(),
-            "function": self._function_snapshot(checkpoint_id),
+            "function": function,
             "operator": self._operator_snapshot(),
         }
 

@@ -359,6 +359,16 @@ class LocalExecutor:
         if from_checkpoint_id is not None:
             # New checkpoints must never overwrite the restore point.
             self.coordinator.resume_from(from_checkpoint_id)
+        job_meta = snapshots.pop("__job__", None)
+        if job_meta:
+            pinned = job_meta.get(0, {}).get("max_parallelism")
+            if pinned is not None and pinned != self.max_parallelism:
+                raise ValueError(
+                    f"checkpoint was taken with max_parallelism={pinned}; "
+                    f"this job uses {self.max_parallelism} — the key-group "
+                    "routing would change and orphan keyed state. Restore "
+                    "with the original max_parallelism."
+                )
         by_task: typing.Dict[str, typing.List[_Subtask]] = {}
         for st in self.subtasks:
             by_task.setdefault(st.t.name, []).append(st)

@@ -309,7 +309,14 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
             views, got = self._ring.claim_batch(b)
             if got < b:
                 # Arena wraparound split this batch: copy out (rare; at
-                # most once per trip around the ring).
+                # most once per trip around the ring).  Ring releases are
+                # strictly oldest-claim-first, so the immediate releases
+                # below would free a still-dispatched batch's slots if
+                # any were in flight — drain them first (their deferred
+                # on_done releases run FIFO), making our claim the oldest.
+                if self.runner._pending:
+                    for record in self.runner.flush():
+                        out.collect(record)
                 arrays = {f: np.empty((b, *v.shape[1:]), v.dtype)
                           for f, v in views.items()}
                 filled = 0

@@ -141,7 +141,19 @@ class TFGraphDefLoader:
     def _as_mapping(spec) -> typing.Dict[str, str]:
         if isinstance(spec, typing.Mapping):
             return dict(spec)
-        return {t.split(":")[0].rsplit("/", 1)[-1]: t for t in spec}
+        out = {}
+        for t in spec:
+            key = t.split(":")[0].rsplit("/", 1)[-1]
+            if key in out:
+                # Two tensors sharing a basename (tower_a/logits,
+                # tower_b/logits) would silently shadow each other —
+                # the caller must name them explicitly.
+                raise ValueError(
+                    f"tensor names {out[key]!r} and {t!r} both map to field "
+                    f"{key!r}; pass a mapping {{field: tensor_name}} instead"
+                )
+            out[key] = t
+        return out
 
     def _graph_def_bytes(self) -> bytes:
         if isinstance(self.graph_def, bytes):

@@ -1,0 +1,151 @@
+"""Regressions pinned from the round-2 broad review.
+
+Each test encodes one confirmed failure scenario: staged fused training
+steps lost at snapshot (keyed state captured before the function flush),
+max_parallelism drift across restore, hopping-gap records mislabeled
+late, and GraphDef basename collisions.
+"""
+
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.functions import OnlineTrainFunction
+from flink_tensorflow_tpu.models import get_model_def
+from flink_tensorflow_tpu.tensors import RecordSchema, TensorValue, spec
+
+
+def _widedeep():
+    return get_model_def("widedeep", hash_buckets=50, embed_dim=4,
+                         num_cat_slots=2, num_dense=3, num_wide=8, hidden=(8,))
+
+
+def _schema():
+    return RecordSchema({
+        "wide": spec((8,)),
+        "dense": spec((3,)),
+        "cat": spec((2,), np.int32),
+        "label": spec((), np.int32),
+    })
+
+
+def _events(n, keys=2):
+    rng = np.random.RandomState(0)
+    return [TensorValue({
+        "wide": rng.rand(8).astype(np.float32),
+        "dense": rng.rand(3).astype(np.float32),
+        "cat": rng.randint(0, 50, (2,)).astype(np.int32),
+        "label": np.int32(i % 2),
+    }, meta={"user": i % keys}) for i in range(n)]
+
+
+class TestSnapshotIncludesStagedSteps:
+    def test_keyed_snapshot_captures_staged_flush(self):
+        """scope='key' + steps_per_dispatch>1: steps staged at the
+        barrier are flushed INTO the keyed capture (the function hook
+        runs before it in Operator.snapshot) — with the old
+        keyed-first order this snapshot's train_state is simply absent
+        (verified: the reverted ordering yields step=None here), and the
+        staged steps' source records precede the barrier so restore
+        would lose them permanently."""
+        from flink_tensorflow_tpu.core import elements as el
+        from flink_tensorflow_tpu.core.operators import Output, ProcessOperator
+        from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
+        from flink_tensorflow_tpu.core.state import KeyedStateStore
+        from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+        f = OnlineTrainFunction(_widedeep(), optax.sgd(0.05),
+                                train_schema=_schema(), scope="key",
+                                mini_batch=1, steps_per_dispatch=4)
+        op = ProcessOperator("t", f, key_selector=lambda r: r.meta["user"])
+        state = KeyedStateStore()
+        ctx = RuntimeContext(task_name="t", subtask_index=0, parallelism=1,
+                             keyed_state=state,
+                             metric_group=MetricRegistry().group("t.0"),
+                             device=None, mesh=None, job_config={})
+        op.setup(ctx, Output([]), state)
+        op.open()
+        for r in _events(3, keys=1):  # 3 steps staged, below the fuse size
+            op.process_record(el.StreamRecord(r, None))
+        snap = op.snapshot(1)
+        ts = snap["keyed"].get("train_state", {}).get(0)
+        assert ts is not None, "staged steps missing from keyed snapshot"
+        assert int(ts["step"]) == 3
+
+
+class TestMaxParallelismPinned:
+    def test_restore_with_changed_max_parallelism_rejected(self, tmp_path):
+        chk = str(tmp_path / "chk")
+        records = [{"k": i % 4, "v": i} for i in range(100)]
+
+        class Count(fn.ProcessFunction):
+            def open(self, ctx):
+                from flink_tensorflow_tpu.core.state import StateDescriptor
+
+                self._d = StateDescriptor("n")
+
+            def process_element(self, value, ctx, out):
+                s = ctx.state(self._d)
+                s.update((s.value() or 0) + 1)
+                out.collect(value)
+
+        def build(env):
+            (
+                env.from_collection(records, parallelism=1)
+                .key_by(lambda r: r["k"])
+                .process(Count(), name="count", parallelism=2)
+                .sink_to_list()
+            )
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(chk)
+        env.source_throttle_s = 0.003
+        build(env)
+        h = env.execute_async("mp")
+        time.sleep(0.1)
+        h.trigger_checkpoint()
+        h.cancel()
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        env2.configure(max_parallelism=64)  # CHANGED key-group count
+        env2.enable_checkpointing(chk)
+        build(env2)
+        with pytest.raises(Exception, match="max_parallelism"):
+            env2.execute("mp", restore_from=chk, timeout=60)
+
+
+class TestHoppingGapNotLate:
+    def test_gap_records_drop_silently_not_late(self):
+        class Collect(fn.WindowFunction):
+            def process_window(self, key, window, elements, out):
+                out.collect([e["t"] for e in elements])
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        # size 1, slide 3: windows [0,1), [3,4), ... — t=1.5 is in a gap.
+        records = [{"t": 0.5}, {"t": 1.5}, {"t": 3.2}]
+        res = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .time_window_all(1.0, slide_s=3.0)
+            .apply(Collect(), name="w", parallelism=1, late_tag="late")
+        )
+        main = res.sink_to_list()
+        late = res.side_output("late").sink_to_list()
+        env.execute("hop", timeout=60)
+        assert main == [[0.5], [3.2]]
+        assert late == []  # gap record belongs to NO window: not late
+
+
+class TestGraphDefNameCollision:
+    def test_duplicate_basenames_rejected(self):
+        from flink_tensorflow_tpu.models.tf_loader import TFGraphDefLoader
+
+        with pytest.raises(ValueError, match="both map to field"):
+            TFGraphDefLoader(
+                b"", inputs=["x:0"],
+                outputs=["tower_a/logits:0", "tower_b/logits:0"],
+            )
