@@ -64,3 +64,16 @@ class SideOutput:
 
     tag: str
     value: typing.Any
+
+
+class SourceIdle:
+    """Sentinel a SourceFunction may yield while WAITING (socket quiet,
+    pacing sleep): no record is emitted, but the source loop gets a turn
+    to serve checkpoint barriers and notifications.  Without it, a
+    source blocked in I/O holds up coordinator-triggered checkpoints
+    indefinitely (the barrier can only be injected between yields)."""
+
+    __slots__ = ()
+
+
+SOURCE_IDLE = SourceIdle()

@@ -605,8 +605,14 @@ class SourceOperator(_FunctionOperator):
             it = self.function.run()
         else:
             it = self.function.run()
-            for _ in range(self._restored_offset):
-                next(it, None)
+            skipped = 0
+            while skipped < self._restored_offset:
+                v = next(it, None)
+                if v is None:
+                    break
+                if isinstance(v, el.SourceIdle):
+                    continue  # heartbeat, not a record — must not count
+                skipped += 1
         self.offset = self._restored_offset
         yield from it
 

@@ -110,6 +110,8 @@ class _Subtask:
                 for cid in self._drain_control():
                     self._snapshot_and_ack(cid)
                     self.output.broadcast_element(el.CheckpointBarrier(cid))
+                if isinstance(value, el.SourceIdle):
+                    continue  # idle heartbeat: barriers served, no record
                 self.output.emit(value)
                 op.record_emitted()
                 # Count-based barriers: checkpoint k cuts the stream after
@@ -192,6 +194,16 @@ class _Subtask:
                             self.output.broadcast_element(el.CheckpointBarrier(cid))
                             del barrier_seen[cid]
                             gate.unblock_all()
+                    # A finished channel no longer holds the combined
+                    # watermark back (Flink: finished inputs count as
+                    # MAX_WATERMARK) — recompute over the live channels.
+                    if active > 0:
+                        new_wm = min(
+                            watermarks[i] for i in range(n) if not eop[i]
+                        )
+                        if new_wm > current_wm:
+                            current_wm = new_wm
+                            op.process_watermark(el.Watermark(current_wm))
             if not self.executor.cancelled.is_set():
                 op.finish()
                 self.output.broadcast_element(el.EndOfPartition())

@@ -185,7 +185,15 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
         static = all(
             all(d is not None for d in schema[n].shape) for n in schema.names
         )
-        eligible = static and not method.needs_lengths
+        # Donated inputs may be overwritten by XLA for outputs; on a CPU
+        # backend device_put aliases the arena views zero-copy, so
+        # donation would let the executable scribble over live ring
+        # slots — the two features are mutually exclusive.
+        eligible = static and not method.needs_lengths and not self._donate
+        if self._use_ring and self._donate:
+            raise ValueError("use_ring=True is incompatible with "
+                             "donate_inputs=True (donated buffers may alias "
+                             "the ring arena)")
         fixed = self.runner.policy.fixed_batch
         if self._ring_capacity is None and fixed is not None:
             # One slot set per in-flight batch + the accumulating window.

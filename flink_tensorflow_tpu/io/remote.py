@@ -150,18 +150,15 @@ class RemoteSource(fn.SourceFunction):
             )
 
     def run(self) -> typing.Iterator[typing.Any]:
-        self._listener.settimeout(self.accept_timeout_s)
-        if self.fan_in == 1:
-            conn, _ = self._listener.accept()
-            conn.settimeout(None)
-            try:
-                yield from _read_frames(conn)
-            finally:
-                conn.close()
-            return
-
+        """Yields records; yields SOURCE_IDLE while waiting (accepting or
+        between frames) so the source loop can serve checkpoint barriers
+        — a source blocked in recv() would otherwise stall coordinator-
+        triggered checkpoints for the whole job."""
         import queue
         import threading
+        import time
+
+        from flink_tensorflow_tpu.core.elements import SOURCE_IDLE
 
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_capacity)
         stop = threading.Event()
@@ -191,9 +188,20 @@ class RemoteSource(fn.SourceFunction):
                 conn.close()
 
         threads, conns = [], []
+        deadline = time.monotonic() + self.accept_timeout_s
+        self._listener.settimeout(0.25)
         try:
-            for _ in range(self.fan_in):
-                conn, _ = self._listener.accept()
+            while len(conns) < self.fan_in:
+                try:
+                    conn, _ = self._listener.accept()
+                except socket.timeout:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"RemoteSource accepted {len(conns)}/{self.fan_in} "
+                            f"peers within {self.accept_timeout_s}s"
+                        ) from None
+                    yield SOURCE_IDLE
+                    continue
                 conn.settimeout(None)
                 conns.append(conn)
                 t = threading.Thread(target=reader, args=(conn,), daemon=True)
@@ -201,7 +209,11 @@ class RemoteSource(fn.SourceFunction):
                 threads.append(t)
             closed = 0
             while closed < self.fan_in:
-                item = q.get()
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    yield SOURCE_IDLE
+                    continue
                 if item is _EOS:
                     closed += 1
                 elif isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:

@@ -148,6 +148,8 @@ class PacedSource(fn.SourceFunction):
         return np.cumsum(gaps)
 
     def run(self):
+        from flink_tensorflow_tpu.core.elements import SOURCE_IDLE
+
         mine = list(range(self._subtask, len(self.data), self._parallelism))
         offsets = self._offsets(len(self.data))
         skipped, mine = mine[:self._seek], mine[self._seek:]
@@ -157,9 +159,15 @@ class PacedSource(fn.SourceFunction):
         t_start = time.monotonic()
         for i in mine:
             due = t_start + self.start_delay_s + float(offsets[i]) - base
-            delay = due - time.monotonic()
-            if delay > 0:
-                time.sleep(delay)
+            while True:
+                delay = due - time.monotonic()
+                if delay <= 0:
+                    break
+                # Sleep in short slices, heartbeating so the source loop
+                # can serve checkpoint barriers during sparse schedules.
+                time.sleep(min(delay, 0.1))
+                if due - time.monotonic() > 0:
+                    yield SOURCE_IDLE
             value = self.data[i]
             if hasattr(value, "with_meta"):
                 value = value.with_meta(**{self.ts_key: due})

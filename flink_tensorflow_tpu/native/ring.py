@@ -57,9 +57,6 @@ def _load_lib():
     lib.ring_push_commit.argtypes = [ctypes.c_void_p]
     lib.ring_poppable.restype = ctypes.c_uint64
     lib.ring_poppable.argtypes = [ctypes.c_void_p]
-    lib.ring_pop_claim.restype = ctypes.c_uint64
-    lib.ring_pop_claim.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                   ctypes.POINTER(ctypes.c_uint64)]
     lib.ring_pop_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     _LIB = lib
     return lib
@@ -113,15 +110,6 @@ class _PyRing:
         with self._lock:
             return self.tail - self.head
 
-    def pop_claim(self, max_n: int) -> typing.Tuple[int, int]:
-        with self._lock:
-            ready = self.tail - self.head
-            if ready == 0:
-                return 0, 0
-            idx = self.head & self.mask
-            n = min(ready, max_n, self.n_slots - idx)
-            return idx, n
-
     def pop_release(self, count: int) -> None:
         with self._lock:
             self.head += count
@@ -155,11 +143,6 @@ class _NativeRing:
 
     def poppable(self) -> int:
         return self._lib.ring_poppable(self._ptr)
-
-    def pop_claim(self, max_n: int) -> typing.Tuple[int, int]:
-        start = ctypes.c_uint64()
-        n = self._lib.ring_pop_claim(self._ptr, max_n, ctypes.byref(start))
-        return int(start.value), int(n)
 
     def pop_release(self, count: int) -> None:
         self._lib.ring_pop_release(self._ptr, count)
@@ -211,7 +194,20 @@ class TensorRing:
 
     # -- producer ----------------------------------------------------------
     def try_push(self, record: typing.Mapping[str, np.ndarray]) -> bool:
-        """Write one record into the ring; False if full (caller backs off)."""
+        """Write one record into the ring; False if full (caller backs off).
+
+        Raises ValueError (BEFORE reserving a slot) when a dynamic field
+        exceeds its resolved bucket — a mid-push broadcast crash would
+        leave a reserved-but-uncommitted slot and kill the producer."""
+        for name, (offset, shape, dtype) in self.layout.items():
+            src_shape = np.asarray(record[name]).shape
+            if src_shape != tuple(shape) and any(
+                s > d for s, d in zip(src_shape, shape)
+            ):
+                raise ValueError(
+                    f"field {name!r} shape {src_shape} exceeds the ring's "
+                    f"slot shape {tuple(shape)} (length_bucket too small)"
+                )
         slot = self._ring.push_reserve()
         if slot < 0:
             return False

@@ -146,8 +146,14 @@ def assemble(
             for ax in dyn_axes:
                 target[ax] = policy.lengths.round_up(max(p.shape[ax] for p in parts))
             # True length on the first dynamic axis (the sequence axis).
+            # Batch-pad rows replay record 0's LENGTH as well as its data:
+            # a zero length with real data would hit 0/0 in any masked-
+            # mean style computation — exactly the NaN path padding is
+            # meant to avoid (pad rows are excluded via `valid` anyway).
+            pad_len = parts[0].shape[dyn_axes[0]]
             lengths[name] = np.array(
-                [p.shape[dyn_axes[0]] for p in parts] + [0] * (b - n), dtype=np.int32
+                [p.shape[dyn_axes[0]] for p in parts] + [pad_len] * (b - n),
+                dtype=np.int32,
             )
             padded = np.zeros((b, *target), dtype=spec.dtype)
             for i, p in enumerate(parts):

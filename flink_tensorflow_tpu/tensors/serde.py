@@ -38,6 +38,14 @@ def encode_record(record: TensorValue) -> bytes:
     buffers = []
     for name, arr in record.fields.items():
         a = np.asarray(arr)
+        if a.dtype.hasobject:
+            # tobytes() on an object array emits raw PyObject POINTERS —
+            # the frame decodes (or crashes) on the peer with garbage.
+            # Fail at the sender, where the offending field is visible.
+            raise TypeError(
+                f"field {name!r} has object dtype {a.dtype} — record fields "
+                "must be numeric/bytes tensors (put Python objects in meta)"
+            )
         # NB: ascontiguousarray would promote 0-d to 1-d; keep the true
         # shape and let tobytes() handle contiguity.
         fields.append([name, list(a.shape), a.dtype.str])

@@ -149,3 +149,61 @@ class TestGraphDefNameCollision:
                 b"", inputs=["x:0"],
                 outputs=["tower_a/logits:0", "tower_b/logits:0"],
             )
+
+
+class TestCheckpointDuringSourceLull:
+    def test_barrier_injected_while_source_waits(self):
+        """A source parked in I/O (remote peer connected but silent) must
+        still serve coordinator-triggered checkpoints — sources heartbeat
+        SOURCE_IDLE while waiting instead of blocking the control loop."""
+        import socket
+        import struct
+        import threading
+
+        from flink_tensorflow_tpu.io.remote import RemoteSource
+        from flink_tensorflow_tpu.tensors.serde import encode_record
+
+        source = RemoteSource("127.0.0.1", 0, fan_in=1)
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = env.from_source(source, name="remote", parallelism=1).sink_to_list()
+
+        release = threading.Event()
+
+        def peer():
+            # Connect, then hold the stream silent until released.
+            data = [TensorValue({"x": np.float32(i)}, {"id": i}) for i in range(3)]
+            sock = socket.create_connection(("127.0.0.1", source.port))
+            release.wait(timeout=30)
+            for r in data:
+                payload = encode_record(r)
+                sock.sendall(struct.pack("<Q", len(payload)) + payload)
+            sock.shutdown(socket.SHUT_WR)
+            sock.close()
+
+        t = threading.Thread(target=peer, daemon=True)
+        t.start()
+        h = env.execute_async("lull")
+        time.sleep(0.3)  # peer connected, stream silent
+        # THE property: a checkpoint completes during the lull.
+        snaps = h.trigger_checkpoint(timeout=15)
+        assert "remote" in snaps
+        release.set()
+        h.wait(60)
+        t.join(timeout=10)
+        assert sorted(r.meta["id"] for r in out) == [0, 1, 2]
+
+
+class TestPadRowLengths:
+    def test_pad_rows_replay_record0_length(self):
+        from flink_tensorflow_tpu.tensors import BucketPolicy, TensorValue
+        from flink_tensorflow_tpu.tensors.batching import assemble
+        from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec
+
+        schema = RecordSchema({"tokens": TensorSpec((None,), np.int32)})
+        recs = [TensorValue({"tokens": np.arange(5, dtype=np.int32)}),
+                TensorValue({"tokens": np.arange(3, dtype=np.int32)})]
+        batch = assemble(recs, schema, BucketPolicy(fixed_batch=4))
+        # Pad rows carry record 0's LENGTH (5), matching their replayed
+        # data — zero lengths with real data would 0/0 in masked means.
+        assert list(batch.lengths["tokens"]) == [5, 3, 5, 5]
+        assert list(batch.valid) == [True, True, False, False]
