@@ -97,18 +97,37 @@ def write_checkpoint(
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
+    # fsync data AND directories before the rename: the rename alone is
+    # journaled, the data blocks are not — without this a crash right
+    # after os.replace can expose chk-N with a truncated state.pkl, and
+    # restore then fails on the "latest" checkpoint instead of falling
+    # back (the torn-restore-point this layout exists to prevent).
     with open(os.path.join(tmp, "state.pkl"), "wb") as f:
         pickle.dump(_to_host(snapshots), f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
     meta = {
         "checkpoint_id": checkpoint_id,
         "tasks": {task: sorted(per_sub.keys()) for task, per_sub in snapshots.items()},
     }
     with open(os.path.join(tmp, "METADATA.json"), "w") as f:
         json.dump(meta, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(tmp)
     if os.path.exists(final):
         shutil.rmtree(final)
     os.replace(tmp, final)
+    _fsync_dir(base_dir)
     return final
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def checkpoint_ids(base_dir: str) -> typing.List[int]:

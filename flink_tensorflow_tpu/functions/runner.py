@@ -139,7 +139,24 @@ class CompiledMethodRunner:
             self._metrics = metrics
 
     def close(self) -> None:
-        self._pending.clear()
+        # Block on dispatched work before dropping it: the executables may
+        # still be READING input buffers that alias the ring arena
+        # (CPU-backend device_put is zero-copy), and the caller frees the
+        # arena right after close() — letting async work run on would be
+        # a use-after-free.  Errors are irrelevant during teardown.
+        import jax
+
+        while self._pending:
+            item = self._pending.popleft()
+            try:
+                if isinstance(item, concurrent.futures.Future):
+                    item = item.result(timeout=60)
+                _, outputs, _, on_done = item
+                jax.block_until_ready(outputs)
+                if on_done is not None:
+                    on_done()
+            except Exception:  # noqa: BLE001 - cancellation teardown
+                pass
         if self._pool is not None:
             self._pool.shutdown(wait=True, cancel_futures=True)
             self._pool = None

@@ -49,6 +49,25 @@ def _to_host(pytree):
     return jax.tree.map(conv, pytree)
 
 
+def _validate_train_schema(schema: RecordSchema) -> RecordSchema:
+    """The batch dict synthesizes ``<field>_len`` (dynamic fields) and
+    ``valid`` keys; schema fields with those names would be silently
+    clobbered — reject them at construction."""
+    for name in schema.names:
+        if name == "valid":
+            raise ValueError(
+                "train_schema field 'valid' collides with the synthesized "
+                "batch-validity mask — rename the feature"
+            )
+        if any(d is None for d in schema[name].shape) and f"{name}_len" in schema.names:
+            raise ValueError(
+                f"train_schema field {name + '_len'!r} collides with the "
+                f"synthesized length array for dynamic field {name!r} — "
+                "rename the feature"
+            )
+    return schema
+
+
 def _train_batch_arrays(records, schema: RecordSchema, policy: BucketPolicy):
     """Assemble training records -> batch dict incl. labels and lengths.
 
@@ -102,7 +121,7 @@ class OnlineTrainFunction(fn.ProcessFunction):
             raise ValueError("steps_per_dispatch must be >= 1")
         self.model_def = model_def
         self.optimizer = optimizer
-        self.train_schema = train_schema
+        self.train_schema = _validate_train_schema(train_schema)
         self.scope = scope
         self.mini_batch = mini_batch
         self.seed = seed
@@ -367,7 +386,7 @@ class DPTrainWindowFunction(fn.WindowFunction):
     ):
         self.model_def = model_def
         self.optimizer = optimizer
-        self.train_schema = train_schema
+        self.train_schema = _validate_train_schema(train_schema)
         self.global_batch = global_batch
         self.seed = seed
         self._step_fn = None

@@ -144,6 +144,11 @@ def import_savedmodel(path: str, model_def: ModelDef, *,
     import jax
 
     external = read_savedmodel_variables(path)
-    template = jax.jit(model_def.init_fn)(rng if rng is not None else jax.random.key(0))
+    # eval_shape: only shapes are consulted (every leaf is replaced) — a
+    # real jitted init would pay a full compile + init FLOPs + a
+    # transient whole-model allocation for nothing.
+    template = jax.eval_shape(
+        model_def.init_fn, rng if rng is not None else jax.random.key(0)
+    )
     variables = assign_by_name(template, external, rules=rules)
     return model_def.to_model(variables)

@@ -38,20 +38,36 @@ BUNDLE_FORMAT = "flink-tensorflow-tpu-bundle"
 # ---------------------------------------------------------------------------
 
 def save_bundle(model_def: ModelDef, params, path: str) -> None:
-    """Write a loadable bundle (the SavedModel-export analogue)."""
+    """Write a loadable bundle (the SavedModel-export analogue).
+
+    Staged write + atomic rename (the checkpoint store's pattern): a
+    crash mid-export must never leave a directory that parses as a
+    bundle but holds truncated params."""
+    import shutil
+
     import flax.serialization
 
-    os.makedirs(path, exist_ok=True)
+    tmp = path.rstrip("/") + ".exporting"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
     manifest = {
         "format": BUNDLE_FORMAT,
         "version": 1,
         "architecture": model_def.architecture,
         "config": model_def.config,
     }
-    with open(os.path.join(path, BUNDLE_MANIFEST), "w") as f:
+    with open(os.path.join(tmp, BUNDLE_MANIFEST), "w") as f:
         json.dump(manifest, f, indent=2)
-    with open(os.path.join(path, BUNDLE_PARAMS), "wb") as f:
+        f.flush()
+        os.fsync(f.fileno())
+    with open(os.path.join(tmp, BUNDLE_PARAMS), "wb") as f:
         f.write(flax.serialization.to_bytes(params))
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
 
 
 class SavedModelLoader:

@@ -72,10 +72,19 @@ class TFSavedModelLoader:
         fields = {}
         for name, spec in sig.structured_input_signature[1].items():
             dims = spec.shape.as_list()
-            # Only a leading None is the conventional dynamic batch dim;
-            # fixed-shape inputs (per-call constants) pass through intact.
-            shape = tuple(dims[1:]) if dims and dims[0] is None else tuple(dims)
-            fields[name] = TensorSpec(shape, np.dtype(spec.dtype.as_numpy_dtype))
+            if not dims or dims[0] is not None:
+                # The streaming path always feeds [B, ...] batches; a
+                # signature input without a leading dynamic batch dim
+                # would silently receive one extra dimension — fail
+                # loudly instead (re-export the model with a batch dim).
+                raise ValueError(
+                    f"signature input {name!r} has shape {dims} without a "
+                    "leading dynamic batch dimension; streaming inference "
+                    "feeds [batch, ...] — re-export the SavedModel with "
+                    "batched inputs"
+                )
+            fields[name] = TensorSpec(tuple(dims[1:]),
+                                      np.dtype(spec.dtype.as_numpy_dtype))
         return RecordSchema(fields)
 
     def load(self) -> Model:
@@ -195,8 +204,14 @@ class TFGraphDefLoader:
         fields = {}
         for name, tensor in zip(self.inputs, pruned.inputs):
             dims = tensor.shape.as_list()
-            shape = tuple(dims[1:]) if dims and dims[0] is None else tuple(dims)
-            fields[name] = TensorSpec(shape, np.dtype(tensor.dtype.as_numpy_dtype))
+            if not dims or dims[0] is not None:
+                raise ValueError(
+                    f"feed {name!r} has shape {dims} without a leading "
+                    "dynamic batch dimension; streaming inference feeds "
+                    "[batch, ...] — freeze the graph with batched inputs"
+                )
+            fields[name] = TensorSpec(tuple(dims[1:]),
+                                      np.dtype(tensor.dtype.as_numpy_dtype))
         return RecordSchema(fields)
 
     def load(self) -> Model:

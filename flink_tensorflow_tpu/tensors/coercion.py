@@ -32,12 +32,10 @@ def _convert_array_like(value, spec: TensorSpec) -> np.ndarray:
     arr = np.asarray(value)
     if arr.dtype != spec.dtype:
         arr = arr.astype(spec.dtype)
-    # Rank promotion: a scalar for a () field, a flat list for a (d,) field.
+    # Rank promotion: a flat list reshapes to a fully-static (d, ...) field.
     if arr.ndim != spec.rank:
         target = tuple(d for d in spec.shape if d is not None)
-        if arr.ndim == 0 and spec.rank == 0:
-            pass
-        elif len(target) == spec.rank and arr.size == int(np.prod(target)):
+        if len(target) == spec.rank and arr.size == int(np.prod(target)):
             arr = arr.reshape(target)
         else:
             raise TypeError(
