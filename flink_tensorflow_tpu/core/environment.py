@@ -49,8 +49,11 @@ class JobHandle:
     def __init__(self, executor: LocalExecutor):
         self.executor = executor
 
-    def trigger_checkpoint(self, timeout: float = 60.0):
-        """Run one aligned checkpoint; returns the snapshot mapping."""
+    def trigger_checkpoint(self, timeout: typing.Optional[float] = None):
+        """Run one aligned checkpoint; returns the snapshot mapping.
+        ``timeout`` defaults to the job's ``checkpoint.timeout_s``."""
+        if timeout is None:
+            timeout = self.executor.checkpoint_timeout_s
         return self.executor.coordinator.trigger(timeout=timeout)
 
     def wait(self, timeout: typing.Optional[float] = None) -> JobResult:
@@ -230,6 +233,7 @@ class StreamExecutionEnvironment:
             source_throttle_s=cfg.source_throttle_s,
             checkpoint_dir=cfg.checkpoint.dir,
             checkpoint_every_n=cfg.checkpoint.every_n_records,
+            checkpoint_timeout_s=cfg.checkpoint.timeout_s,
             max_parallelism=cfg.max_parallelism,
         )
 

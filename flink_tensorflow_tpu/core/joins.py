@@ -155,10 +155,16 @@ class IntervalJoinOperator(_FunctionOperator):
                 "— add .assign_timestamps(...) upstream of both inputs"
             )
         ts = record.timestamp
-        # An element is late when it could no longer be emitted against
-        # even a fresh opposite-side arrival at the watermark.
-        horizon = ts + self.upper if input_index == 0 else ts - self.lower
-        if horizon < self._watermark:
+        # Late bound == the RETENTION bound (the admissibility limit the
+        # eviction code documents): an arrival is dead only when no
+        # retained-or-future opposite element can pair with it.  A
+        # tighter arrival check (e.g. ts - lower >= wm) silently drops
+        # on-time elements whenever the interval excludes zero.
+        if input_index == 0:
+            dead = ts + self.upper < self._watermark + self.lower
+        else:
+            dead = ts - self.lower < self._watermark - self.upper
+        if dead:
             return
         selector = self.key_selector1 if input_index == 0 else self.key_selector2
         key = selector(record.value)
@@ -195,7 +201,14 @@ class IntervalJoinOperator(_FunctionOperator):
                         if ts - self.lower >= wm - self.upper]
             if not left and not right:
                 del self._state[key]
-        self.output.broadcast_element(watermark)
+        # Hold the downstream watermark back by the interval span: a
+        # retained left has lts >= wm + lower - upper, so future
+        # emissions (stamped max(lts, rts)) can be as old as
+        # wm - (upper - lower); broadcasting the raw wm would make
+        # downstream event-time windows drop those results as late.
+        self.output.broadcast_element(
+            el.Watermark(wm - (self.upper - self.lower))
+        )
 
     def _operator_snapshot(self):
         return {

@@ -234,6 +234,7 @@ class LocalExecutor:
         source_throttle_s: float = 0.0,
         checkpoint_dir: typing.Optional[str] = None,
         checkpoint_every_n: typing.Optional[int] = None,
+        checkpoint_timeout_s: float = 60.0,
         max_parallelism: int = 128,
     ):
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
@@ -246,6 +247,7 @@ class LocalExecutor:
         self.job_config = job_config or {}
         self.source_throttle_s = source_throttle_s
         self.checkpoint_every_n = checkpoint_every_n
+        self.checkpoint_timeout_s = checkpoint_timeout_s
         self.max_parallelism = max_parallelism
         self.cancelled = threading.Event()
         self._error: typing.Optional[BaseException] = None
@@ -426,7 +428,7 @@ class LocalExecutor:
         interval = self.checkpoint_interval_s
         while not self._all_done.wait(interval) and not self.cancelled.is_set():
             try:
-                self.coordinator.trigger(timeout=max(60.0, interval * 10))
+                self.coordinator.trigger(timeout=self.checkpoint_timeout_s)
             except Exception:
                 # Catch EVERYTHING: an escaping error (serialization bug,
                 # disk full, ...) would otherwise kill this daemon thread

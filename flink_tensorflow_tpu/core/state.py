@@ -58,9 +58,11 @@ class KeyedStateStore:
         table = self._tables.get(descriptor.name)
         if table is None or self.current_key not in table:
             if descriptor.default_factory is not None:
-                value = descriptor.default_factory()
-                self.put(descriptor, value)
-                return value
+                # Return WITHOUT storing (Flink's ValueState.value rule):
+                # storing on read would create a table entry for every
+                # key ever probed, bloating snapshots; callers persist a
+                # default by calling update() explicitly.
+                return descriptor.default_factory()
             return None
         return table[self.current_key]
 

@@ -162,8 +162,15 @@ class DataStream:
     def broadcast(self) -> "DataStream":
         return DataStream(self.env, self.transformation, BroadcastPartitioner())
 
-    def union(self, *others: "DataStream") -> "UnionStream":
-        return UnionStream(self.env, [self, *others])
+    def union(self, *others: "DataStream") -> "DataStream":
+        """Merge streams into ONE materialized stream (an identity merge
+        operator with one input edge per stream).  Materializing makes
+        every downstream API — key_by, windows, joins, further unions —
+        see all inputs; a lazy multi-edge view would silently bind only
+        the first stream anywhere a single upstream edge is built."""
+        merged = _UnionStream(self.env, [self, *others])
+        return merged.map(lambda v: v, name="union",
+                          parallelism=self.transformation.parallelism)
 
     def side_output(self, tag: str) -> "DataStream":
         """Tap a named side output (e.g. the late-data stream of an
@@ -256,8 +263,9 @@ class DataStream:
         return out
 
 
-class UnionStream(DataStream):
-    """Merge of several streams; next operator reads all of them."""
+class _UnionStream(DataStream):
+    """Internal: multi-edge view used ONLY to build the union's merge
+    operator (its _add_op wires one edge per input stream)."""
 
     def __init__(self, env, streams: typing.List[DataStream]):
         super().__init__(env, streams[0].transformation)

@@ -32,9 +32,13 @@ class BiLSTMClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, lengths):
-        # Embedding lookups are gathers (HBM-bound); keep the table bf16.
+        # Embedding lookups are gathers (HBM-bound); STORE the table in
+        # the compute dtype (param_dtype) — dtype= alone keeps an f32
+        # table and casts the whole thing per apply, doubling both the
+        # footprint and the bandwidth the comment exists to save.
         emb = nn.Embed(self.vocab_size, self.embed_dim,
-                       dtype=self.compute_dtype)(tokens)
+                       dtype=self.compute_dtype,
+                       param_dtype=self.compute_dtype)(tokens)
         fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.compute_dtype),
                      return_carry=True)
         bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=self.compute_dtype),

@@ -118,10 +118,23 @@ def replicated(mesh):
     return named_sharding(mesh)
 
 
+_SPANS_CACHE: typing.MutableMapping[int, bool] = {}
+
+
 def spans_processes(mesh) -> bool:
     """True when the mesh's devices live in more than one process — the
-    multi-host case where each process holds only its local batch shard."""
-    return len({d.process_index for d in mesh.devices.flat}) > 1
+    multi-host case where each process holds only its local batch shard.
+    Cached per mesh: shard_batch calls this per micro-batch, and walking
+    every device object each time is O(devices) hot-path Python work for
+    an invariant."""
+    key = id(mesh)
+    hit = _SPANS_CACHE.get(key)
+    if hit is None:
+        hit = len({d.process_index for d in mesh.devices.flat}) > 1
+        if len(_SPANS_CACHE) > 64:  # meshes are few and long-lived
+            _SPANS_CACHE.clear()
+        _SPANS_CACHE[key] = hit
+    return hit
 
 
 def shard_batch(mesh, pytree):
