@@ -22,6 +22,7 @@ mode (tests) — same code path, no hand-written fallback to drift.
 
 from __future__ import annotations
 
+import functools
 import math
 import typing
 
@@ -48,8 +49,8 @@ def flash_attention(
 
     b, t, h, d = q.shape
     tk = k.shape[1]
-    block_q = math.gcd(block_q, t)
-    block_k = math.gcd(block_k, tk)
+    block_q = _tileable_block(t, block_q)
+    block_k = _tileable_block(tk, block_k)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -67,6 +68,22 @@ def flash_attention(
     return out
 
 
+def _tileable_block(t: int, pref: int) -> int:
+    """Largest TPU-tileable block for a dim of size ``t``: Mosaic needs
+    the block's sublane dim divisible by 8 OR equal to the whole array
+    dim.  (A gcd here produced sizes like 4 for t=100, which lowers fine
+    in interpret mode but crashes Mosaic on the real chip.)"""
+    if t <= pref:
+        return t  # one block spanning the dim — always legal
+    for b in (pref, 128, 64, 32, 16, 8):
+        if b <= pref and t % b == 0:
+            return b
+    # No multiple-of-8 divisor (e.g. t odd): one whole-dim block.
+    # Correct but VMEM-heavy for very long odd lengths — the stream
+    # layer's power-of-two buckets keep production shapes off this path.
+    return t
+
+
 def _vma(*xs):
     """Union of the operands' varying-mesh-axes sets — required on pallas
     out_shapes when the kernel runs inside shard_map (check_vma=True)."""
@@ -80,12 +97,28 @@ def _vma(*xs):
 
 def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
     import jax
+
+    bh, t, d = q.shape
+    # Dtype keyed by NAME: ml_dtypes (bfloat16) have no portable .str.
+    fn = _build_flash_call(
+        bh, t, k.shape[1], d, jax.numpy.dtype(q.dtype).name, causal,
+        block_q, block_k, interpret, _vma(q, k, v),
+    )
+    return fn(q, k, v)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_flash_call(bh, t, tk, d, dtype_str, causal, block_q, block_k,
+                      interpret, vma):
+    """Jitted pallas_call per static configuration.  Building a fresh
+    closure per invocation would defeat jax.jit's cache (keyed on the
+    function object) and recompile the Mosaic kernel on EVERY eager call."""
+    import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, t, d = q.shape
-    tk = k.shape[1]
+    dtype = jnp.dtype(dtype_str)
     nq, nk = t // block_q, tk // block_k
     scale = 1.0 / math.sqrt(d)
 
@@ -162,14 +195,21 @@ def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, t, d), dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        # bh and q-blocks are independent programs (scratch re-inits at
+        # j==0 per (bh, qi)): declaring them parallel lets Mosaic
+        # megacore-partition the grid on v4/v5p; only the K sweep is
+        # order-dependent (online-softmax carry).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )
-    return jax.jit(fn)(q, k, v)
+    return jax.jit(fn)

@@ -50,7 +50,9 @@ def central_crop(x: jax.Array, fraction: float) -> jax.Array:
     if not 0.0 < fraction <= 1.0:
         raise ValueError(f"fraction must be in (0, 1], got {fraction}")
     h, w = x.shape[-3], x.shape[-2]
-    ch, cw = int(h * fraction), int(w * fraction)
+    # round(), not int(): binary floats put e.g. 100*0.29 an epsilon
+    # below 29, and truncation would silently crop one row short.
+    ch, cw = max(1, round(h * fraction)), max(1, round(w * fraction))
     top, left = (h - ch) // 2, (w - cw) // 2
     return x[..., top:top + ch, left:left + cw, :]
 

@@ -29,7 +29,12 @@ def trace(log_dir: str, *, host_tracer_level: int = 2):
     """
     import jax
 
-    jax.profiler.start_trace(log_dir, create_perfetto_trace=False)
+    options = None
+    if host_tracer_level != 2:  # 2 is the profiler default
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=False,
+                             profiler_options=options)
     try:
         yield
     finally:

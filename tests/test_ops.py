@@ -89,3 +89,36 @@ class TestFlashAttention:
         _, lse = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                                  causal=True, return_lse=True)
         assert np.all(np.isfinite(np.asarray(lse)))
+
+
+class TestTileableBlocks:
+    def test_block_selection_is_mosaic_legal(self):
+        """Mosaic requires a block's sublane dim divisible by 8 OR equal
+        to the whole array dim; the old gcd picked sizes like 4 for
+        t=100, which crashed only on the real chip (interpret mode can't
+        catch it)."""
+        from flink_tensorflow_tpu.ops.flash_attention import _tileable_block
+
+        for t in [8, 12, 64, 100, 128, 136, 200, 264, 1000, 1001, 4096]:
+            b = _tileable_block(t, 128)
+            assert t % b == 0, (t, b)
+            assert b % 8 == 0 or b == t, (t, b)
+            assert b <= 128 or b == t, (t, b)
+
+    def test_non_divisible_lengths_match_reference(self):
+        """Shapes that used to crash Mosaic (t=100, 264, mixed) run the
+        same kernel path in interpret mode and match full attention."""
+        import jax.numpy as jnp
+
+        from flink_tensorflow_tpu.ops.flash_attention import flash_attention
+        from flink_tensorflow_tpu.parallel import full_attention
+
+        rng = np.random.RandomState(3)
+        for t, tk in [(100, 100), (264, 136), (12, 200)]:
+            q = rng.randn(1, t, 2, 16).astype(np.float32)
+            k = rng.randn(1, tk, 2, 16).astype(np.float32)
+            v = rng.randn(1, tk, 2, 16).astype(np.float32)
+            got = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+            want = full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5)
