@@ -383,15 +383,25 @@ class DPTrainWindowFunction(fn.WindowFunction):
         train_schema: RecordSchema,
         global_batch: int,
         seed: int = 0,
+        pipeline_depth: int = 2,
     ):
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self.model_def = model_def
         self.optimizer = optimizer
         self.train_schema = _validate_train_schema(train_schema)
         self.global_batch = global_batch
         self.seed = seed
+        #: Steps whose METRICS are still in flight (the step dispatch is
+        #: always async; fetching each loss synchronously pays a device
+        #: round trip per window — the next window's h2d transfer should
+        #: overlap this step's compute instead).
+        self.pipeline_depth = pipeline_depth
         self._step_fn = None
         self._state = None
         self._restored = None
+        self._pending: typing.Optional[typing.Deque] = None
+        self._step_no = 0
         self._policy = BucketPolicy(fixed_batch=global_batch)
         self.mesh = None
 
@@ -401,6 +411,7 @@ class DPTrainWindowFunction(fn.WindowFunction):
         dup = copy.copy(self)
         dup._step_fn = None
         dup._state = None
+        dup._pending = None
         return dup
 
     def open(self, ctx) -> None:
@@ -441,23 +452,50 @@ class DPTrainWindowFunction(fn.WindowFunction):
             self.model_def, optimizer, jax.random.key(self.seed)
         )
         self._restored = None
+        # Concrete at open (fresh init or restored host snapshot);
+        # later states are pipelined futures we must not sync on.
+        self._step_no = int(state["step"])
         self._state = replicate(self.mesh, state)
 
     def process_window(self, key, window, elements, out: fn.Collector) -> None:
-        import numpy as np
+        import collections
 
         from flink_tensorflow_tpu.parallel.mesh import shard_batch
 
+        self._out = out
         _, arrays = _train_batch_arrays(list(elements), self.train_schema, self._policy)
         batch = shard_batch(self.mesh, arrays)
+        # Dispatch-and-go: the state chains asynchronously; metrics fetch
+        # lags by pipeline_depth so the NEXT window's h2d transfer
+        # overlaps this step's device compute.
         self._state, metrics = self._step_fn(self._state, batch)
-        host = {k: np.asarray(v) for k, v in metrics.items()}
-        host["step"] = np.asarray(int(self._state["step"]), np.int64)
-        out.collect(TensorValue(host))
-        self.ctx.metrics.meter("train_records").mark(len(elements))
-        self.ctx.metrics.counter("train_steps").inc()
+        self._step_no += 1
+        if self._pending is None:
+            self._pending = collections.deque()
+        self._pending.append((metrics, self._step_no, len(elements)))
+        self._drain(out, self.pipeline_depth - 1)
+
+    def _drain(self, out: fn.Collector, keep: int) -> None:
+        import numpy as np
+
+        while self._pending and len(self._pending) > keep:
+            metrics, step_no, n = self._pending.popleft()
+            host = {k: np.asarray(v) for k, v in metrics.items()}
+            host["step"] = np.asarray(step_no, np.int64)
+            out.collect(TensorValue(host))
+            self.ctx.metrics.meter("train_records").mark(n)
+            self.ctx.metrics.counter("train_steps").inc()
+
+    def on_finish(self, out: fn.Collector) -> None:
+        if self._pending:
+            self._drain(out, 0)
 
     def snapshot_state(self):
+        # Emit in-flight metrics before the barrier (their records
+        # precede it and never replay); _to_host then blocks on the
+        # chained state, capturing every dispatched step.
+        if self._pending and getattr(self, "_out", None) is not None:
+            self._drain(self._out, 0)
         return {"state": _to_host(self._state) if self._state is not None else None}
 
     def restore_state(self, snap) -> None:
