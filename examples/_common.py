@@ -57,9 +57,17 @@ def report(job: str, metrics: dict, t0: float, records: int, extra: dict = None)
         "records_per_s": round(records / wall, 2) if wall > 0 else None,
     }
     out.update(extra or {})
+    # One latency histogram per SUBTASK: report the worst across them
+    # (overwriting per key would report whichever subtask iterates last).
+    p50s, p99s = [], []
     for key, value in metrics.items():
         if key.endswith("record_latency_s") and isinstance(value, dict):
-            out["p50_latency_ms"] = round(value["p50"] * 1e3, 3)
-            out["p99_latency_ms"] = round(value["p99"] * 1e3, 3)
+            p50s.append(value["p50"])
+            p99s.append(value["p99"])
+    if p50s:
+        out["p50_latency_ms"] = round(max(p50s) * 1e3, 3)
+        out["p99_latency_ms"] = round(max(p99s) * 1e3, 3)
+        if len(p50s) > 1:
+            out["latency_aggregation"] = f"max over {len(p50s)} subtasks"
     print(json.dumps(out))
     return out

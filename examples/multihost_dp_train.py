@@ -30,6 +30,7 @@ import json
 import os
 import sys
 import tempfile
+import typing
 import time
 
 sys.path.insert(0, ".")
@@ -238,12 +239,23 @@ def _aggregate_phase(args, losses) -> None:
 # parent mode (the JobManager analogue)
 # ---------------------------------------------------------------------------
 
-def _free_port() -> int:
+def _free_ports(n: int) -> typing.List[int]:
+    """n DISTINCT free ports: all sockets bind simultaneously before any
+    closes, so the kernel cannot hand the same port out twice (bind-then-
+    close one at a time can — a coordinator/agg-port collision crashes a
+    worker with EADDRINUSE and burns a cohort restart attempt)."""
     import socket
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def run_parent(args) -> dict:
@@ -254,7 +266,8 @@ def run_parent(args) -> dict:
     if args.base_port:
         ports = {a: (args.base_port + a, args.base_port + 500 + a) for a in range(4)}
     else:
-        ports = {a: (_free_port(), _free_port()) for a in range(4)}
+        flat = _free_ports(8)
+        ports = {a: (flat[2 * a], flat[2 * a + 1]) for a in range(4)}
 
     def command(worker, num_workers, attempt):
         cport, aport = ports[attempt]

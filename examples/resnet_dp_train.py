@@ -56,6 +56,10 @@ def main(argv=None):
     schema = RecordSchema({"image": spec((size, size, 3)),
                            "label": spec((), np.int32)})
 
+    if args.parallelism != 1:
+        print("note: --parallelism is ignored here — the DP gang operator "
+              "runs at stream-parallelism 1 and owns ALL devices via the "
+              f"mesh (data={n_dev})", file=sys.stderr)
     env = StreamExecutionEnvironment(parallelism=1)
     env.set_mesh(mesh)
     out = (

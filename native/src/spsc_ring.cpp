@@ -51,8 +51,13 @@ Ring* ring_create(uint64_t slot_size, uint64_t n_slots) {
   r->n_slots = pow2;
   r->mask = pow2 - 1;
   // 64-byte alignment: slot 0 starts cacheline-aligned, and typical
-  // record shapes keep rows well-aligned for the numpy views.
-  r->arena = static_cast<uint8_t*>(aligned_alloc(64, slot_size * pow2));
+  // record shapes keep rows well-aligned for the numpy views.  The
+  // SIZE must also be a 64-multiple — aligned_alloc with a size that is
+  // not a multiple of the alignment is UB per C11/C++17 (NULL on
+  // conforming allocators); the Python layout always 64-rounds slot
+  // sizes, but the C ABI must not depend on that.
+  uint64_t bytes = (slot_size * pow2 + 63u) & ~uint64_t{63};
+  r->arena = static_cast<uint8_t*>(aligned_alloc(64, bytes));
   if (!r->arena) {
     delete r;
     return nullptr;
@@ -94,23 +99,13 @@ uint64_t ring_poppable(Ring* r) {
   return tail - head;
 }
 
-// Consumer: claim up to max_n ready records as one CONTIGUOUS run of
-// slots (stops at the arena wrap point).  Writes the first slot index to
-// *start and returns the claimed count (0 if empty).  The claimed slots
-// stay valid until ring_pop_release(count).
-uint64_t ring_pop_claim(Ring* r, uint64_t max_n, uint64_t* start) {
-  uint64_t ready = ring_poppable(r);
-  if (ready == 0) return 0;
-  uint64_t head = r->head.load(std::memory_order_relaxed);
-  uint64_t idx = head & r->mask;
-  uint64_t until_wrap = r->n_slots - idx;
-  uint64_t n = ready < max_n ? ready : max_n;
-  if (n > until_wrap) n = until_wrap;
-  *start = idx;
-  return n;
-}
+// (No pop_claim in the C ABI: overlapping claims — several dispatched
+// batches in flight — need a claim cursor independent of head, which
+// lives in the Python TensorRing layer; a head-based claim here would
+// silently double-claim on repeated calls.)
 
-// Consumer: free the claimed slots for reuse.
+// Consumer: free the OLDEST claimed slots for reuse (releases are
+// strictly FIFO with respect to the TensorRing layer's claims).
 void ring_pop_release(Ring* r, uint64_t count) {
   r->head.fetch_add(count, std::memory_order_release);
 }

@@ -6,21 +6,18 @@ devices.  Env vars must be set before jax initializes its backends, hence
 at conftest import time.
 """
 
-import os
-
 # Force CPU even when the environment preselects a TPU platform (e.g.
 # JAX_PLATFORMS=axon tunneling to one real chip): tests need the virtual
 # 8-device mesh, and must not monopolize/depend on bench hardware.  The
 # env var alone is not enough — the axon PJRT plugin re-registers itself
 # as default — so pin the platform via jax.config too.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+from flink_tensorflow_tpu.utils.platform import force_cpu
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+# force_cpu REPLACES any preset device-count flag (a stray
+# XLA_FLAGS=--xla_force_host_platform_device_count=4 in the environment
+# would otherwise silently shrink the suite's required 8-device mesh and
+# fail tests confusingly) and pins jax.config past the axon plugin.
+force_cpu(8)
 
 import pytest  # noqa: E402
 
