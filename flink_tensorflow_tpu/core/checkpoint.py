@@ -169,40 +169,48 @@ class CheckpointCoordinator:
             self._seed_finished(pending)
         return True
 
-    def _complete_async(self, pending: _PendingCheckpoint) -> None:
-        """Finish a source-initiated checkpoint (no trigger() caller):
-        persist off the acking subtask's thread, serialized in completion
-        order.  join()/wait_for_persistence drains the queue so completed
-        checkpoints are durable before the job reports done."""
+    def _complete_locked(self, pending: _PendingCheckpoint) -> None:
+        """Finish a source-initiated checkpoint (no trigger() caller).
+
+        MUST be called while holding ``self._lock``: the persist/notify
+        job is enqueued to the single-worker pool in the same critical
+        section that decided completion, so jobs are strictly ordered by
+        checkpoint id.  Submitting after releasing the lock let two acking
+        threads race — checkpoint k+1's notify could run before k was
+        durable, and a 2PC sink would promote k-bound transactions on a
+        checkpoint whose write might still fail.  join() /
+        wait_for_persistence drain the queue, so completed checkpoints
+        (and, without a checkpoint_dir, their notifications) land before
+        the job reports done."""
         self._completed.append(pending.checkpoint_id)
+
         if self.checkpoint_dir is None:
-            self.executor.notify_checkpoint_complete(pending.checkpoint_id)
-            return
+            def job():
+                self.executor.notify_checkpoint_complete(pending.checkpoint_id)
+        else:
+            def job():
+                from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
-        def persist():
-            from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
+                try:
+                    write_checkpoint(self.checkpoint_dir, pending.checkpoint_id,
+                                     self._with_job_meta(pending.snapshots))
+                except Exception:  # pragma: no cover - disk trouble
+                    import logging
 
-            try:
-                write_checkpoint(self.checkpoint_dir, pending.checkpoint_id,
-                                 self._with_job_meta(pending.snapshots))
-            except Exception:  # pragma: no cover - disk trouble
-                import logging
+                    logging.getLogger(__name__).warning(
+                        "persisting checkpoint %d failed", pending.checkpoint_id,
+                        exc_info=True,
+                    )
+                    return  # NOT durable: the 2PC commit signal must not fire
+                self.executor.notify_checkpoint_complete(pending.checkpoint_id)
 
-                logging.getLogger(__name__).warning(
-                    "persisting checkpoint %d failed", pending.checkpoint_id,
-                    exc_info=True,
-                )
-                return  # NOT durable: the 2PC commit signal must not fire
-            self.executor.notify_checkpoint_complete(pending.checkpoint_id)
+        if self._persist_pool is None:
+            import concurrent.futures
 
-        with self._lock:
-            if self._persist_pool is None:
-                import concurrent.futures
-
-                self._persist_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="chk-persist"
-                )
-            self._persist_futures.append(self._persist_pool.submit(persist))
+            self._persist_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="chk-persist"
+            )
+        self._persist_futures.append(self._persist_pool.submit(job))
 
     def wait_for_persistence(self, timeout: typing.Optional[float] = 60.0) -> int:
         """Block until every completed checkpoint has landed on disk.
@@ -242,12 +250,11 @@ class CheckpointCoordinator:
                 pending.done.set()
                 if pending.source_initiated:
                     del self._pending[checkpoint_id]
-        if finished and pending.source_initiated and not pending.failed:
-            self._complete_async(pending)
+                    if not pending.failed:
+                        self._complete_locked(pending)
 
     def subtask_finished(self, subtask: "_Subtask") -> None:
         key = (subtask.t.name, subtask.index)
-        completed = []
         with self._lock:
             try:
                 snap = subtask.operator.snapshot()
@@ -263,9 +270,7 @@ class CheckpointCoordinator:
                         if pending.source_initiated:
                             del self._pending[cid]
                             if not pending.failed:
-                                completed.append(pending)
-        for pending in completed:
-            self._complete_async(pending)
+                                self._complete_locked(pending)
 
     def cancel_pending(self) -> None:
         with self._lock:

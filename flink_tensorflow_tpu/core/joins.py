@@ -44,11 +44,19 @@ class WindowJoinOperator(_FunctionOperator):
         if size_s <= 0:
             raise ValueError(f"window size must be positive, got {size_s}")
         self.size = float(size_s)
+        #: Single source of truth for window arithmetic — assignment,
+        #: fire, late check, and stamp all derive from integer ns.
+        self._size_ns = round(self.size * 1e9)
         self.key_selector1 = key_selector1
         self.key_selector2 = key_selector2
-        #: {(key, start): (left elements, right elements)}
+        #: {(key, start): (end, left elements, right elements)} — the end
+        #: is the ns-derived value computed at assignment so the fire
+        #: check, late check, and result stamp all use the SAME number;
+        #: recomputing it as ``start + size`` in float disagrees at the
+        #: boundary for non-binary-representable sizes (drop-as-late
+        #: while open, or double-fire).
         self._buffers: typing.Dict[typing.Tuple[typing.Any, float],
-                                   typing.Tuple[list, list]] = {}
+                                   typing.Tuple[float, list, list]] = {}
         self._watermark = -math.inf
 
     def process_record(self, record):  # pragma: no cover - indexed dispatch only
@@ -61,24 +69,23 @@ class WindowJoinOperator(_FunctionOperator):
                 "— add .assign_timestamps(...) upstream of both inputs"
             )
         ts = record.timestamp
-        size_ns = round(self.size * 1e9)
+        size_ns = self._size_ns
         start_ns = (round(ts * 1e9) // size_ns) * size_ns
         start, end = start_ns / 1e9, (start_ns + size_ns) / 1e9
         if end <= self._watermark:
             return  # late, window already fired
         selector = self.key_selector1 if input_index == 0 else self.key_selector2
         key = selector(record.value)
-        sides = self._buffers.get((key, start))
-        if sides is None:
-            sides = ([], [])
-            self._buffers[(key, start)] = sides
-        sides[input_index].append(record.value)
+        buf = self._buffers.get((key, start))
+        if buf is None:
+            buf = (end, [], [])
+            self._buffers[(key, start)] = buf
+        buf[1 + input_index].append(record.value)
 
     def process_watermark(self, watermark: el.Watermark) -> None:
         self._watermark = max(self._watermark, watermark.timestamp)
-        size = self.size
         due = sorted(
-            (k for k in self._buffers if k[1] + size <= self._watermark),
+            (k for k, buf in self._buffers.items() if buf[0] <= self._watermark),
             key=lambda k: (k[1], str(k[0])),
         )
         for k in due:
@@ -86,10 +93,9 @@ class WindowJoinOperator(_FunctionOperator):
         self.output.broadcast_element(watermark)
 
     def _fire(self, k) -> None:
-        left, right = self._buffers.pop(k)
-        key, start = k
+        end, left, right = self._buffers.pop(k)
+        key, _start = k
         self.keyed_state.current_key = key
-        end = start + self.size
         for l in left:
             for r in right:
                 self.output.emit(self.function.join(l, r), end)
@@ -101,14 +107,27 @@ class WindowJoinOperator(_FunctionOperator):
     def _operator_snapshot(self):
         return {
             "watermark": self._watermark,
-            "buffers": {k: (list(l), list(r)) for k, (l, r) in self._buffers.items()},
+            "buffers": {k: (end, list(l), list(r))
+                        for k, (end, l, r) in self._buffers.items()},
         }
 
     def _operator_restore(self, state):
         self._watermark = state["watermark"]
         self._buffers = {
-            tuple(k): (list(l), list(r)) for k, (l, r) in state["buffers"].items()
+            tuple(k): self._upgrade_buffer(k, buf)
+            for k, buf in state["buffers"].items()
         }
+
+    def _upgrade_buffer(self, k, buf):
+        """Accept pre-r3 snapshots whose buffer values were (left, right)
+        without the stored end — backfill it with the same ns derivation
+        assignment uses."""
+        if len(buf) == 3:
+            end, l, r = buf
+            return (end, list(l), list(r))
+        l, r = buf
+        start_ns = round(k[1] * 1e9)
+        return ((start_ns + self._size_ns) / 1e9, list(l), list(r))
 
     def _rescale_operator_state(self, states, mine):
         from flink_tensorflow_tpu.core.event_time import _min_watermark
@@ -117,9 +136,9 @@ class WindowJoinOperator(_FunctionOperator):
         for s in states:
             if not s:
                 continue
-            for (key, start), (l, r) in s["buffers"].items():
+            for (key, start), buf in s["buffers"].items():
                 if mine(key):
-                    buffers[(key, start)] = (list(l), list(r))
+                    buffers[(key, start)] = self._upgrade_buffer((key, start), buf)
         return {"watermark": _min_watermark(states), "buffers": buffers}
 
 
@@ -139,6 +158,16 @@ class IntervalJoinOperator(_FunctionOperator):
             raise ValueError(f"interval lower {lower_s} > upper {upper_s}")
         self.lower = float(lower_s)
         self.upper = float(upper_s)
+        # Slack terms for the admissibility bounds below.  For intervals
+        # containing zero they equal (lower, upper); for intervals that
+        # EXCLUDE zero they clamp to 0, which is exactly Flink's
+        # retention bound (left lives until wm > lts + upper, right until
+        # wm > rts - lower): with e.g. lower > 0 an on-time right at
+        # rts >= wm can still pair a left as old as lts = rts - upper >=
+        # wm - upper, so evicting at lts + upper < wm + lower (the
+        # pre-fix bound) silently dropped valid pairs.
+        self._lo_slack = min(self.lower, 0.0)
+        self._hi_slack = max(self.upper, 0.0)
         self.key_selector1 = key_selector1
         self.key_selector2 = key_selector2
         #: Per key: ([(ts, left value)], [(ts, right value)]).
@@ -161,9 +190,9 @@ class IntervalJoinOperator(_FunctionOperator):
         # tighter arrival check (e.g. ts - lower >= wm) silently drops
         # on-time elements whenever the interval excludes zero.
         if input_index == 0:
-            dead = ts + self.upper < self._watermark + self.lower
+            dead = ts + self.upper < self._watermark + self._lo_slack
         else:
-            dead = ts - self.lower < self._watermark - self.upper
+            dead = ts - self.lower < self._watermark - self._hi_slack
         if dead:
             return
         selector = self.key_selector1 if input_index == 0 else self.key_selector2
@@ -189,16 +218,19 @@ class IntervalJoinOperator(_FunctionOperator):
         self._watermark = max(self._watermark, watermark.timestamp)
         wm = self._watermark
         for key, (left, right) in list(self._state.items()):
-            # Retention must mirror the OPPOSITE side's acceptance bound:
-            # a future right is accepted while rts - lower >= wm, i.e.
-            # rts >= wm + lower, and pairs a left when rts <= lts + upper
-            # — so a left stays live while lts + upper >= wm + lower
-            # (symmetric for rights).  Evicting at the tighter bound
-            # would drop elements whose match is still admissible.
+            # Retention must cover every opposite arrival the dead-check
+            # still admits: watermark-future ones (ts >= wm) AND
+            # accepted-late ones down at the slack bound.  A left pairs
+            # rights with rts <= lts + upper; the oldest admissible
+            # future right is rts >= wm + lo_slack, so a left stays live
+            # while lts + upper >= wm + lo_slack (symmetric for rights).
+            # Using the raw lower/upper here (the pre-fix bound) evicts
+            # too early whenever the interval excludes zero — see the
+            # slack-term comment in __init__.
             left[:] = [(ts, v) for ts, v in left
-                       if ts + self.upper >= wm + self.lower]
+                       if ts + self.upper >= wm + self._lo_slack]
             right[:] = [(ts, v) for ts, v in right
-                        if ts - self.lower >= wm - self.upper]
+                        if ts - self.lower >= wm - self._hi_slack]
             if not left and not right:
                 del self._state[key]
         # Hold the downstream watermark back by the interval span: a

@@ -123,7 +123,12 @@ class Operator:
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:  # noqa: B027
         """Checkpoint ``checkpoint_id`` is complete AND durable — the
         commit signal for two-phase sinks (Flink's CheckpointListener).
-        Delivered on the subtask thread (single-writer contract)."""
+
+        Normally delivered on the subtask thread (single-writer
+        contract); a checkpoint that completes as the job ends is flushed
+        best-effort from the join thread AFTER close() — the operator is
+        quiescent then, but hooks must not require close()-released
+        resources (a failure there is logged, not raised)."""
 
     def restore(self, snap: typing.Dict[str, typing.Any]) -> None:
         self.keyed_state.restore(snap["keyed"])

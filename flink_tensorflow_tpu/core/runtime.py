@@ -456,6 +456,26 @@ class LocalExecutor:
                 f"{in_flight} checkpoint persist write(s) did not drain — "
                 "completed checkpoints are not yet durable"
             )
+        # The persist queue fans notifications out via add_notification,
+        # but a notification enqueued after a subtask's loop exited would
+        # sit undelivered forever (delivery runs on the subtask thread).
+        # All threads are joined and all persist jobs drained here, so
+        # the join thread can flush the leftovers without violating the
+        # single-writer contract — this is what makes "durable before the
+        # job reports done" include the final checkpoint's 2PC commit.
+        # Best-effort, Flink-style: this late delivery runs AFTER the
+        # operator's close(), so a hook that needs close()-released
+        # resources may fail — log and keep flushing the remaining
+        # subtasks rather than failing a job that already completed.
+        if self._error is None:
+            for st in self.subtasks:
+                try:
+                    st._deliver_notifications()
+                except Exception:
+                    logger.warning(
+                        "post-close checkpoint notification failed for %s",
+                        st.scope, exc_info=True,
+                    )
         if self._error is not None:
             raise JobFailure(f"job failed: {self._error!r}") from self._error
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import typing
+import weakref
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -118,7 +119,11 @@ def replicated(mesh):
     return named_sharding(mesh)
 
 
-_SPANS_CACHE: typing.MutableMapping[int, bool] = {}
+# Keyed on the mesh object itself via weakref — an id()-keyed dict went
+# stale when a mesh was garbage-collected and a NEW mesh reused the same
+# id, silently inheriting the old answer and sending shard_batch down
+# the wrong single- vs multi-process path.  Entries die with their mesh.
+_SPANS_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def spans_processes(mesh) -> bool:
@@ -127,13 +132,16 @@ def spans_processes(mesh) -> bool:
     Cached per mesh: shard_batch calls this per micro-batch, and walking
     every device object each time is O(devices) hot-path Python work for
     an invariant."""
-    key = id(mesh)
-    hit = _SPANS_CACHE.get(key)
+    try:
+        hit = _SPANS_CACHE.get(mesh)
+    except TypeError:  # unhashable/unweakrefable stand-in (test doubles)
+        return len({d.process_index for d in mesh.devices.flat}) > 1
     if hit is None:
         hit = len({d.process_index for d in mesh.devices.flat}) > 1
-        if len(_SPANS_CACHE) > 64:  # meshes are few and long-lived
-            _SPANS_CACHE.clear()
-        _SPANS_CACHE[key] = hit
+        try:
+            _SPANS_CACHE[mesh] = hit
+        except TypeError:  # pragma: no cover - unweakrefable mesh
+            pass
     return hit
 
 
