@@ -68,6 +68,41 @@ class FlatMapFunction(RichFunction, abc.ABC):
     def flat_map(self, value: typing.Any) -> typing.Iterable[typing.Any]: ...
 
 
+class AsyncMapFunction(RichFunction, abc.ABC):
+    """One-in/one-out map whose results may be emitted ASYNCHRONOUSLY.
+
+    ``stream.map(f)`` hosts this exactly like a :class:`MapFunction`, but
+    the operator hands ``map_async`` a collector instead of taking a
+    return value: the function may buffer the record (e.g. into an
+    in-flight device batch) and emit its result on a later call.  The
+    contract the operator relies on:
+
+    - **FIFO**: results are collected in arrival order (result i is for
+      record i) — the operator re-attaches record timestamps positionally.
+    - ``flush(out)`` synchronously emits everything in flight; called at
+      end of input and before every state snapshot so barriers never
+      have results in limbo.
+    - ``next_deadline``/``fire_due`` bound latency in a lull (idle
+      flush), mirroring the window-function hooks.
+
+    This is the pipelined per-record model path (SURVEY.md §3.1): the
+    reference's flagship ``stream.map(modelFn)`` idiom without paying
+    one device round trip per record.
+    """
+
+    @abc.abstractmethod
+    def map_async(self, value: typing.Any, out: "Collector") -> None: ...
+
+    def flush(self, out: "Collector") -> None:  # noqa: B027
+        """Synchronously emit all buffered/in-flight results."""
+
+    def next_deadline(self) -> typing.Optional[float]:
+        return None
+
+    def fire_due(self, now: float) -> None:  # noqa: B027
+        pass
+
+
 class FilterFunction(RichFunction, abc.ABC):
     @abc.abstractmethod
     def filter(self, value: typing.Any) -> bool: ...

@@ -13,6 +13,7 @@ the per-FLOP path — one operator invocation per *batch*, not per scalar.
 
 from __future__ import annotations
 
+import collections
 import time
 import typing
 
@@ -248,8 +249,65 @@ class _FunctionOperator(Operator):
 
 
 class MapOperator(_FunctionOperator):
+    """Hosts a MapFunction, or an AsyncMapFunction with deferred emission.
+
+    For async functions the operator keeps a FIFO of input timestamps and
+    re-attaches them positionally as results surface (the function's
+    FIFO-order contract), flushes in-flight work at end of input and —
+    via ``_function_snapshot`` -> ``snapshot_state`` -> ``flush`` — before
+    every barrier, and forwards the idle-flush timer hooks."""
+
+    def __init__(self, name, function):
+        super().__init__(name, function)
+        self._async = isinstance(self.function, fn.AsyncMapFunction)
+        self._collector: typing.Optional[fn.Collector] = None
+        self._ts_fifo: typing.Deque[typing.Optional[float]] = collections.deque()
+
+    def open(self) -> None:
+        if self._async:
+            def emit(value, _ts):
+                ts = self._ts_fifo.popleft() if self._ts_fifo else None
+                self.output.emit(value, ts)
+
+            self._collector = fn.Collector(emit)
+        super().open()
+
     def process_record(self, record):
-        self.output.emit(self.function.map(record.value), record.timestamp)
+        if self._async:
+            self._ts_fifo.append(record.timestamp)
+            self.function.map_async(record.value, self._collector)
+        else:
+            self.output.emit(self.function.map(record.value), record.timestamp)
+
+    def process_watermark(self, watermark):
+        # A watermark must not overtake in-flight results: flush the
+        # function's buffered/in-flight records first, or downstream
+        # event-time operators would see them arrive "late" (< watermark)
+        # and drop them.
+        if self._async:
+            self.function.flush(self._collector)
+        super().process_watermark(watermark)
+
+    def finish(self):
+        if self._async:
+            self.function.flush(self._collector)
+
+    def _function_snapshot(self, checkpoint_id=None):
+        # Enforce the AsyncMapFunction barrier contract AT the operator:
+        # everything in flight is emitted before the snapshot regardless
+        # of whether the function's own snapshot_state also flushes.
+        # After the flush the timestamp FIFO is empty, so there is no
+        # operator-side state left to snapshot.
+        if self._async:
+            self.function.flush(self._collector)
+        return super()._function_snapshot(checkpoint_id)
+
+    def next_deadline(self):
+        return self.function.next_deadline() if self._async else None
+
+    def fire_due(self, now):
+        if self._async:
+            self.function.fire_due(now)
 
 
 class FlatMapOperator(_FunctionOperator):
@@ -438,7 +496,10 @@ class WindowOperator(_FunctionOperator):
 
     def __init__(self, name, function: fn.WindowFunction, trigger: Trigger, key_selector=None):
         super().__init__(name, function)
-        self.trigger = trigger
+        # Parallel subtasks each construct their own operator from the
+        # shared factory closure — clone the trigger so ones carrying
+        # mutable estimator state (AdaptiveLatencyTrigger) don't race.
+        self.trigger = trigger.clone()
         self.key_selector = key_selector
         self._buffers: typing.Dict[typing.Any, WindowBuffer] = {}
         self._window_seq: typing.Dict[typing.Any, int] = {}

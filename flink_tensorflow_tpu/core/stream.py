@@ -33,6 +33,7 @@ from flink_tensorflow_tpu.core.partitioning import (
     RebalancePartitioner,
 )
 from flink_tensorflow_tpu.core.windows import (
+    AdaptiveLatencyTrigger,
     CountOrTimeoutTrigger,
     CountTrigger,
     SlidingCountTrigger,
@@ -41,14 +42,22 @@ from flink_tensorflow_tpu.core.windows import (
 
 
 def _count_trigger(size: int, slide: typing.Optional[int],
-                   timeout_s: typing.Optional[float]) -> Trigger:
+                   timeout_s: typing.Optional[float],
+                   latency_budget_s: typing.Optional[float] = None) -> Trigger:
     if slide is not None:
-        if timeout_s is not None:
+        if timeout_s is not None or latency_budget_s is not None:
             raise ValueError(
-                "sliding count windows do not take timeout_s (a sliding "
-                "fire is driven by arrivals, not deadlines)"
+                "sliding count windows do not take timeout_s/latency_budget_s "
+                "(a sliding fire is driven by arrivals, not deadlines)"
             )
         return SlidingCountTrigger(size, slide)
+    if latency_budget_s is not None:
+        if timeout_s is not None:
+            raise ValueError(
+                "pass either timeout_s (static flush deadline) or "
+                "latency_budget_s (adaptive rate-projected flush), not both"
+            )
+        return AdaptiveLatencyTrigger(size, latency_budget_s)
     if timeout_s is not None:
         return CountOrTimeoutTrigger(size, timeout_s)
     return CountTrigger(size)
@@ -134,7 +143,8 @@ class DataStream:
 
     # -- transforms -------------------------------------------------------
     def map(self, f: typing.Union[fn.MapFunction, typing.Callable], *, name="map", parallelism=None) -> "DataStream":
-        func = f if isinstance(f, fn.MapFunction) else _LambdaMap(f)
+        func = (f if isinstance(f, (fn.MapFunction, fn.AsyncMapFunction))
+                else _LambdaMap(f))
         t = self._add_op(name, lambda: MapOperator(name, func), parallelism)
         return DataStream(self.env, t)
 
@@ -235,16 +245,24 @@ class DataStream:
     def count_window(
         self, size: int, *, slide: typing.Optional[int] = None,
         timeout_s: typing.Optional[float] = None,
+        latency_budget_s: typing.Optional[float] = None,
     ) -> "WindowedStream":
         """Per-subtask count window (the micro-batch primitive).
 
-        ``timeout_s`` turns it into the adaptive count-or-timeout batcher
-        bounding p50 latency (SURVEY.md §7 hard part 3).  ``slide`` makes
+        ``timeout_s`` turns it into the count-or-timeout batcher (static
+        flush deadline); ``latency_budget_s`` instead installs the
+        :class:`AdaptiveLatencyTrigger`, which projects the fill time
+        from an EWMA of the arrival rate and flushes partial windows
+        early when they provably won't fill inside the budget (SURVEY.md
+        §7 hard part 3 — the latency-TARGETING policy).  ``slide`` makes
         it a sliding window: fire every ``slide`` records with the last
-        ``size`` (overlapping micro-batches; incompatible with timeout_s).
+        ``size`` (overlapping micro-batches; incompatible with either
+        deadline option).
         """
-        return WindowedStream(self.env, self, _count_trigger(size, slide, timeout_s),
-                              key_selector=None)
+        return WindowedStream(
+            self.env, self,
+            _count_trigger(size, slide, timeout_s, latency_budget_s),
+            key_selector=None)
 
     # -- sinks ------------------------------------------------------------
     def add_sink(self, sink: fn.SinkFunction, *, name="sink", parallelism=None) -> Transformation:
@@ -304,9 +322,12 @@ class KeyedStream:
     def count_window(
         self, size: int, *, slide: typing.Optional[int] = None,
         timeout_s: typing.Optional[float] = None,
+        latency_budget_s: typing.Optional[float] = None,
     ) -> "WindowedStream":
-        return WindowedStream(self.env, self, _count_trigger(size, slide, timeout_s),
-                              key_selector=self.key_selector)
+        return WindowedStream(
+            self.env, self,
+            _count_trigger(size, slide, timeout_s, latency_budget_s),
+            key_selector=self.key_selector)
 
     def time_window(
         self, size_s: float, slide_s: typing.Optional[float] = None

@@ -46,6 +46,12 @@ class Trigger:
         """Processing-time deadline at which the window must flush, or None."""
         return None
 
+    def clone(self) -> "Trigger":
+        """Per-subtask copy.  Stateless triggers (the default) are shared;
+        triggers carrying mutable estimator state override this so
+        parallel subtasks don't race on it."""
+        return self
+
     # -- retention (sliding windows) -----------------------------------
     def retains(self) -> bool:
         """True when fires carry elements over into the next window
@@ -97,6 +103,82 @@ class CountOrTimeoutTrigger(Trigger):
         if not window_state.elements:
             return None
         return window_state.first_element_time + self.timeout_s
+
+
+class AdaptiveLatencyTrigger(Trigger):
+    """Latency-TARGETED adaptive batcher (SURVEY.md §7 hard part 3): fires
+    at B elements like a count trigger, but instead of holding partial
+    windows for a static timeout it maintains an EWMA of the observed
+    inter-arrival gap and fires a partial window as soon as the
+    projection says the window cannot fill within the latency budget.
+
+    Policy, per open window:
+
+    - full (``n >= count``): fire (pure count behavior — at high offered
+      rates the projection is short and batches stay full for the MXU);
+    - projected fill time ``last_arrival + (count - n) * ewma_gap``
+      within ``first_arrival + latency_budget_s``: keep waiting (the
+      batch will fill in time);
+    - otherwise the window provably won't fill inside the budget, so
+      holding the buffered records buys nothing: flush one expected gap
+      after the last arrival (a Nagle-style grace so micro-bursts still
+      coalesce), never later than the hard budget.
+
+    At 0.5x capacity this puts p50 near one inter-arrival gap plus the
+    small-batch service time instead of near the budget — the static
+    ``CountOrTimeoutTrigger`` parks every record at the timeout
+    (measured 1149ms p50 vs a 1000ms timeout, BENCH_r02).
+
+    The EWMA is per-subtask (``clone``) and pools across keys of a keyed
+    window — it estimates the subtask's aggregate arrival process.
+    """
+
+    def __init__(self, count: int, latency_budget_s: float, *,
+                 ewma_alpha: float = 0.25):
+        if count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        if latency_budget_s <= 0:
+            raise ValueError(
+                f"latency_budget_s must be positive, got {latency_budget_s}")
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        self.count = count
+        self.latency_budget_s = latency_budget_s
+        self.ewma_alpha = ewma_alpha
+        self._gap_ewma: typing.Optional[float] = None
+        self._last_arrival: typing.Optional[float] = None
+
+    def clone(self) -> "AdaptiveLatencyTrigger":
+        return AdaptiveLatencyTrigger(
+            self.count, self.latency_budget_s, ewma_alpha=self.ewma_alpha)
+
+    def on_element(self, window_state):
+        now = time.monotonic()
+        if self._last_arrival is not None:
+            gap = now - self._last_arrival
+            self._gap_ewma = (
+                gap if self._gap_ewma is None
+                else (1.0 - self.ewma_alpha) * self._gap_ewma
+                + self.ewma_alpha * gap
+            )
+        self._last_arrival = now
+        if len(window_state.elements) >= self.count:
+            return True
+        d = self.deadline(window_state)
+        return d is not None and now >= d
+
+    def deadline(self, window_state):
+        if not window_state.elements:
+            return None
+        hard = window_state.first_element_time + self.latency_budget_s
+        if self._gap_ewma is None or self._last_arrival is None:
+            return hard  # no rate estimate yet: behave like the timeout
+        remaining = self.count - len(window_state.elements)
+        projected_fill = self._last_arrival + remaining * self._gap_ewma
+        if projected_fill <= hard:
+            return hard  # on track to fill: let the count fire
+        # Won't fill in budget: flush after one expected gap of quiet.
+        return min(hard, self._last_arrival + self._gap_ewma)
 
 
 class SlidingCountTrigger(Trigger):

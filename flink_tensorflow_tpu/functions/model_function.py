@@ -25,6 +25,7 @@ N independent model replicas, the reference's inference-DP story
 
 from __future__ import annotations
 
+import time
 import typing
 
 import numpy as np
@@ -107,15 +108,102 @@ class _ModelFunctionBase(fn.RichFunction):
             self.runner = None
 
 
-class ModelMapFunction(_ModelFunctionBase, fn.MapFunction):
-    """Per-record inference: ``stream.map(ModelMapFunction(bundle))``."""
+class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
+    """Per-record inference: ``stream.map(ModelMapFunction(bundle))``.
 
-    def __init__(self, model: ModelSource, method: str = "serve", **kw):
-        kw.setdefault("policy", BucketPolicy(fixed_batch=1))
+    The reference's flagship idiom (SURVEY.md §3.1) — but NOT one
+    synchronous device round trip per record: arriving records accumulate
+    into a transparent micro-batch (at most ``micro_batch``, dispatched
+    the moment it fills) and up to ``pipeline_depth`` batches ride the
+    runner's dispatch/collect pipeline concurrently, so the wire transfer
+    of batch k+1 overlaps the device compute of batch k exactly like the
+    windowed path.  Results surface in arrival order.  A lull flushes the
+    partial batch after ``idle_flush_s`` (the map stays a per-record
+    operator: latency is bounded by the flush timer, not by batch fill),
+    and end-of-input / snapshot barriers flush everything in flight.
+
+    ``micro_batch=1`` recovers strict per-record dispatch — still
+    pipelined, so throughput is bounded by ``pipeline_depth / RTT``
+    rather than ``1 / RTT``.
+
+    Buckets: partial flushes assemble to the smallest policy bucket
+    >= the buffered count (powers of two up to ``micro_batch`` by
+    default), padding the remainder, so a flush never recompiles.
+    """
+
+    def __init__(self, model: ModelSource, method: str = "serve", *,
+                 micro_batch: int = 8,
+                 pipeline_depth: typing.Optional[int] = None,
+                 idle_flush_s: float = 0.01, **kw):
+        if micro_batch < 1:
+            raise ValueError(f"micro_batch must be >= 1, got {micro_batch}")
+        if "policy" not in kw:
+            sizes = []
+            b = 1
+            while b < micro_batch:
+                sizes.append(b)
+                b *= 2
+            sizes.append(micro_batch)
+            kw["policy"] = BucketPolicy(batch=BucketLadder(sizes))
         super().__init__(model, method, **kw)
+        if pipeline_depth is None:
+            pipeline_depth = max(2, 2 * self._transfer_lanes)
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self._micro_batch = micro_batch
+        self._max_in_flight = pipeline_depth - 1
+        self._idle_flush_s = idle_flush_s
+        self._buf: typing.List[typing.Any] = []
+        self._last_activity: typing.Optional[float] = None
 
-    def map(self, value):
-        return self.runner.run_batch([value])[0]
+    def clone(self) -> "fn.Function":
+        dup = super().clone()
+        dup._buf = []
+        dup._last_activity = None
+        return dup
+
+    def map_async(self, value, out: fn.Collector):
+        self._out = out
+        self._buf.append(value)
+        if len(self._buf) >= self._micro_batch:
+            self._dispatch_buf()
+        self._last_activity = time.monotonic()
+        for record in self.runner.collect_ready(self._max_in_flight):
+            out.collect(record)
+
+    def _dispatch_buf(self):
+        if self._buf:
+            self.runner.dispatch(self._buf)
+            self._buf = []
+
+    def flush(self, out: typing.Optional[fn.Collector] = None):
+        out = out if out is not None else self._out
+        self._dispatch_buf()
+        if self.runner is not None and out is not None:
+            for record in self.runner.flush():
+                out.collect(record)
+
+    # -- latency bound in a lull (MapOperator timer hooks) ---------------
+    def next_deadline(self) -> typing.Optional[float]:
+        if self._last_activity is None:
+            return None
+        if not self._buf and not (self.runner and self.runner._pending):
+            return None
+        return self._last_activity + self._idle_flush_s
+
+    def fire_due(self, now: float) -> None:
+        d = self.next_deadline()
+        if d is not None and now >= d:
+            self.flush()
+
+    def on_finish(self, out: fn.Collector):
+        self.flush(out)
+
+    def snapshot_state(self):
+        # Barrier alignment: everything buffered or in flight is emitted
+        # BEFORE the snapshot, so no result is in limbo across restore.
+        self.flush()
+        return None
 
 
 class _RingToken:
@@ -276,8 +364,6 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
 
     # -- firing ------------------------------------------------------------
     def process_window(self, key, window, elements, out: fn.Collector):
-        import time
-
         elements = list(elements)
         self._out = out
         tokens = all(isinstance(e, _RingToken) for e in elements) and bool(elements)

@@ -1,0 +1,194 @@
+"""AdaptiveLatencyTrigger — the latency-TARGETING batching policy
+(SURVEY.md §7 hard part 3; VERDICT r2 next-round #2).
+
+Unit tests pin the policy math on a fake clock; the integration test
+runs a paced sub-saturation stream and asserts partial windows flush at
+the arrival cadence instead of parking at the hard budget (the static
+CountOrTimeoutTrigger's failure mode: p50 ~ timeout)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core import windows as W
+from flink_tensorflow_tpu.core.operators import WindowOperator
+from flink_tensorflow_tpu.io import PacedSource
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(W.time, "monotonic", c)
+    return c
+
+
+def _arrive(trigger, buf, clock, t):
+    clock.t = t
+    buf.add(object(), None)
+    return trigger.on_element(buf)
+
+
+class TestAdaptiveLatencyTriggerPolicy:
+    def test_fills_like_count_trigger_at_high_rate(self, clock):
+        """Arrivals fast enough to fill within budget: no early fire, the
+        count fires the full window."""
+        trig = W.AdaptiveLatencyTrigger(4, 1.0)
+        buf = W.WindowBuffer(window=W.CountWindow(0))
+        assert not _arrive(trig, buf, clock, 100.00)
+        assert not _arrive(trig, buf, clock, 100.01)
+        # Projection: 2 remaining * 0.01s << budget -> hold for the count.
+        assert trig.deadline(buf) == pytest.approx(100.0 + 1.0)
+        assert not _arrive(trig, buf, clock, 100.02)
+        assert _arrive(trig, buf, clock, 100.03)  # full at 4
+
+    def test_flushes_one_gap_after_last_arrival_at_low_rate(self, clock):
+        """Arrivals too slow to fill: deadline collapses to one expected
+        gap past the last arrival, NOT the hard budget."""
+        trig = W.AdaptiveLatencyTrigger(16, 1.0)
+        buf = W.WindowBuffer(window=W.CountWindow(0))
+        _arrive(trig, buf, clock, 100.0)
+        # No estimate yet: conservative hard deadline.
+        assert trig.deadline(buf) == pytest.approx(101.0)
+        _arrive(trig, buf, clock, 100.3)
+        # gap ewma = 0.3; 14 remaining -> fill at ~104.5 > 101 budget:
+        # flush at last_arrival + gap = 100.6.
+        assert trig.deadline(buf) == pytest.approx(100.6)
+
+    def test_deadline_never_exceeds_hard_budget(self, clock):
+        trig = W.AdaptiveLatencyTrigger(16, 0.2)
+        buf = W.WindowBuffer(window=W.CountWindow(0))
+        _arrive(trig, buf, clock, 100.0)
+        _arrive(trig, buf, clock, 100.19)
+        assert trig.deadline(buf) <= 100.0 + 0.2
+
+    def test_arrival_refreshes_grace_but_not_past_budget(self, clock):
+        """An arrival into a window whose one-gap grace lapsed REFRESHES
+        the grace (Nagle-style micro-burst coalescing) — the lapsed
+        deadline is fire_due's job, not on_element's.  The hard budget
+        is not refreshable: an arrival past it fires immediately."""
+        trig = W.AdaptiveLatencyTrigger(16, 1.0)
+        buf = W.WindowBuffer(window=W.CountWindow(0))
+        _arrive(trig, buf, clock, 100.0)
+        _arrive(trig, buf, clock, 100.1)   # ewma 0.1 -> grace 100.2
+        assert not _arrive(trig, buf, clock, 100.5)  # grace refreshed
+        assert trig.deadline(buf) > 100.5
+        assert _arrive(trig, buf, clock, 101.05)  # past first+budget: fire
+
+    def test_ewma_persists_across_windows(self, clock):
+        """The rate estimate carries into the next window: its FIRST
+        element already projects (no conservative full-budget wait)."""
+        trig = W.AdaptiveLatencyTrigger(16, 1.0)
+        buf = W.WindowBuffer(window=W.CountWindow(0))
+        _arrive(trig, buf, clock, 100.0)
+        _arrive(trig, buf, clock, 100.4)
+        buf2 = W.WindowBuffer(window=W.CountWindow(1))
+        _arrive(trig, buf2, clock, 100.8)
+        # gap ewma ~0.4 -> 15 remaining won't fill in 1s: one-gap flush.
+        assert trig.deadline(buf2) < 100.8 + 0.5
+
+    def test_empty_buffer_has_no_deadline(self, clock):
+        trig = W.AdaptiveLatencyTrigger(4, 1.0)
+        assert trig.deadline(W.WindowBuffer(window=W.CountWindow(0))) is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            W.AdaptiveLatencyTrigger(0, 1.0)
+        with pytest.raises(ValueError):
+            W.AdaptiveLatencyTrigger(4, 0.0)
+        with pytest.raises(ValueError):
+            W.AdaptiveLatencyTrigger(4, 1.0, ewma_alpha=0.0)
+
+
+class _CollectWindows(fn.WindowFunction):
+    def __init__(self, sizes, latencies, ts_key="sched_ts"):
+        self.sizes = sizes
+        self.latencies = latencies
+        self.ts_key = ts_key
+
+    def clone(self):
+        return self  # shared collector across subtasks (parallelism 1)
+
+    def process_window(self, key, window, elements, out):
+        now = time.monotonic()
+        self.sizes.append(len(elements))
+        for e in elements:
+            sched = e.meta.get(self.ts_key)
+            if sched is not None:
+                self.latencies.append(now - sched)
+            out.collect(e)
+
+
+class TestWindowOperatorIntegration:
+    def test_trigger_cloned_per_operator(self):
+        trig = W.AdaptiveLatencyTrigger(4, 1.0)
+        op = WindowOperator("w", _CollectWindows([], []), trig)
+        assert op.trigger is not trig
+        assert isinstance(op.trigger, W.AdaptiveLatencyTrigger)
+        # Stateless triggers stay shared (no behavior change).
+        ct = W.CountTrigger(4)
+        assert WindowOperator("w2", _CollectWindows([], []), ct).trigger is ct
+
+    def test_stateless_triggers_share_instance(self):
+        t = W.CountOrTimeoutTrigger(4, 1.0)
+        assert t.clone() is t
+
+    def test_paced_substream_flushes_at_arrival_cadence(self):
+        """20 records at ~25 rec/s into count_window(16,
+        latency_budget_s=2.0): the window provably can't fill 16 slots
+        within... it CAN (16/25 = 0.64s < 2) — so use a slower rate.
+        10 records at 10 rec/s, window 64, budget 1.5s: fill needs 6.4s
+        -> early flush ~one gap (0.1s) after each lull.  With the static
+        timeout this stream's p50 would sit at the 1.5s budget."""
+        from flink_tensorflow_tpu.tensors import TensorValue
+
+        records = [TensorValue({"x": np.float32(i)}, {"i": i}) for i in range(10)]
+        sizes, latencies = [], []
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_source(
+                PacedSource(records, 10.0, jitter="none"), name="paced",
+                parallelism=1)
+            .count_window(64, latency_budget_s=1.5)
+            .apply(_CollectWindows(sizes, latencies), name="adaptive")
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert sum(sizes) == 10
+        # Early flush: no window waited for the full 64, and the policy
+        # must have split the stream into several small windows.
+        assert len(sizes) >= 3
+        # Latency below the 1.5s budget: p50 ~ one 0.1s gap + slack (the
+        # static timeout would park every record at ~1.5s; the loose 1.0
+        # bound absorbs CI scheduling noise while still separating the
+        # two behaviors).
+        lat = np.percentile(np.asarray(latencies), 50)
+        assert lat < 1.0, f"p50 {lat:.3f}s should beat the 1.5s budget"
+
+    def test_full_rate_stream_keeps_full_windows(self):
+        """from_collection (infinite rate): every steady window is full —
+        the adaptive policy must not shrink batches when the rate
+        supports filling."""
+        from flink_tensorflow_tpu.tensors import TensorValue
+
+        records = [TensorValue({"x": np.float32(i)}, {"i": i}) for i in range(64)]
+        sizes, latencies = [], []
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_collection(records, parallelism=1)
+            .count_window(16, latency_budget_s=5.0)
+            .apply(_CollectWindows(sizes, latencies), name="adaptive")
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert sizes == [16, 16, 16, 16]
