@@ -57,6 +57,17 @@ class CheckpointCoordinator:
     def __init__(self, executor: "LocalExecutor", checkpoint_dir: typing.Optional[str] = None):
         self.executor = executor
         self.checkpoint_dir = checkpoint_dir
+        #: Distributed record plane: barriers may originate at sources on
+        #: PEER processes, so the first local sighting of checkpoint k is
+        #: an ack from a worker subtask, not begin_source_checkpoint —
+        #: register the pending checkpoint lazily at that ack.
+        self.lazy_register = False
+        #: Distributed commit point: called with the checkpoint id after
+        #: the LOCAL shard is durable and before notifications fire.  A
+        #: False return withholds the 2PC commit signal (the checkpoint
+        #: is not yet durable on every process); staged sink transactions
+        #: then promote via a later checkpoint, clean finish, or restore.
+        self.commit_gate: typing.Optional[typing.Callable[[int], bool]] = None
         self._next_id = 1
         self._lock = threading.Lock()
         #: Serializes whole trigger() calls: a trigger arriving while one
@@ -94,6 +105,15 @@ class CheckpointCoordinator:
                 "manual/timer checkpoints are disabled when "
                 "checkpoint.every_n_records is set — barrier positions must "
                 "stay a deterministic function of the stream"
+            )
+        if self.lazy_register or self.commit_gate is not None:
+            # A manual trigger reaches only LOCAL sources and would
+            # commit without the global durability gate — on a cohort
+            # that is a divergent, gate-bypassing checkpoint.
+            raise RuntimeError(
+                "manual checkpoints are not available on distributed jobs — "
+                "configure checkpoint.every_n_records (deterministic "
+                "cohort-wide barrier positions)"
             )
         deadline = time.monotonic() + timeout
         if not self._trigger_lock.acquire(timeout=timeout):
@@ -186,6 +206,9 @@ class CheckpointCoordinator:
 
         if self.checkpoint_dir is None:
             def job():
+                if self.commit_gate is not None and not self.commit_gate(
+                        pending.checkpoint_id):
+                    return
                 self.executor.notify_checkpoint_complete(pending.checkpoint_id)
         else:
             def job():
@@ -202,6 +225,13 @@ class CheckpointCoordinator:
                         exc_info=True,
                     )
                     return  # NOT durable: the 2PC commit signal must not fire
+                # Distributed jobs gate the commit signal on the checkpoint
+                # being durable on EVERY process — a locally-durable shard
+                # of a globally-incomplete checkpoint must not promote 2PC
+                # transactions (a cohort restore would rewind past it).
+                if self.commit_gate is not None and not self.commit_gate(
+                        pending.checkpoint_id):
+                    return
                 self.executor.notify_checkpoint_complete(pending.checkpoint_id)
 
         if self._persist_pool is None:
@@ -241,6 +271,15 @@ class CheckpointCoordinator:
     def ack(self, checkpoint_id: int, task: str, subtask_index: int, snapshot: typing.Any) -> None:
         with self._lock:
             pending = self._pending.get(checkpoint_id)
+            if (pending is None and self.lazy_register
+                    and checkpoint_id >= self._next_id):
+                pending = _PendingCheckpoint(
+                    checkpoint_id, self.executor.total_subtasks,
+                    source_initiated=True,
+                )
+                self._pending[checkpoint_id] = pending
+                self._next_id = checkpoint_id + 1
+                self._seed_finished(pending)
             if pending is None:
                 return
             pending.snapshots.setdefault(task, {})[subtask_index] = snapshot

@@ -86,6 +86,11 @@ class JobConfig:
     #: User-level parameters readable from RuntimeContext (the reference's
     #: GlobalJobParameters role).  Not interpreted by the framework.
     user_params: typing.Mapping[str, typing.Any] = dataclasses.field(default_factory=dict)
+    #: Cohort membership for the cross-process record plane (subtasks
+    #: placed over processes, keyed/rebalance edges spanning them through
+    #: the shuffle).  None = single-process execution.  See
+    #: core.distributed.DistributedConfig.
+    distributed: typing.Optional[typing.Any] = None
 
     def validate(self) -> "JobConfig":
         if self.parallelism < 1:
@@ -106,5 +111,13 @@ class JobConfig:
             raise ValueError("device_provider must be callable (task, idx) -> device")
         if self.mesh is not None and not hasattr(self.mesh, "devices"):
             raise ValueError(f"mesh must be a jax.sharding.Mesh, got {type(self.mesh).__name__}")
+        if self.distributed is not None:
+            self.distributed.validate()
+            if self.checkpoint.interval_s is not None:
+                raise ValueError(
+                    "distributed jobs checkpoint with count-based triggers "
+                    "(checkpoint.every_n_records), not interval_s — barrier "
+                    "positions must be deterministic across the cohort"
+                )
         self.checkpoint.validate()
         return self

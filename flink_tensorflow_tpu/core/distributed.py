@@ -1,0 +1,308 @@
+"""Distributed job execution — transparent cross-process record plane.
+
+The reference runs on Flink's JobManager/TaskManager cluster: operator
+subtasks are spread over TaskManagers and ``keyBy``/rebalance edges span
+them through the network shuffle, with checkpoint barriers flowing
+through the network channels (SURVEY.md §1 L1, §2 "Distributed
+communication backend").  :class:`DistributedExecutor` is that story for
+the TPU framework:
+
+- Every process of the cohort builds the IDENTICAL ``DataflowGraph``
+  (deterministic job construction — the same contract Flink's client-
+  side StreamGraph translation relies on) and instantiates only the
+  subtasks placed on it: subtask ``i`` runs on process ``i %
+  num_processes``.
+- Edges whose endpoints land on different processes become
+  :class:`~flink_tensorflow_tpu.core.shuffle.RemoteChannelWriter`
+  channels into the peer's
+  :class:`~flink_tensorflow_tpu.core.shuffle.ShuffleServer`.  Records,
+  watermarks, checkpoint barriers and end-of-partition all cross the
+  wire, so downstream barrier ALIGNMENT works exactly as in-process —
+  no ``RemoteSink``/``RemoteSource`` hand-wiring, no reliance on the
+  count-trigger convention for consistency (VERDICT r2 missing #1).
+- Each process's checkpoint coordinator persists the shard holding its
+  local subtasks' state under the shared checkpoint id; barrier ids
+  originate at sources (count-based triggers) and reach peer processes
+  through the remote channels (``CheckpointCoordinator.lazy_register``).
+  Restore: each process restores its own shard — placement is a pure
+  function of (subtask index, num_processes), so the same cohort shape
+  finds its state; changing ``num_processes`` across a restore is
+  rejected rather than silently dropping peer-held keyed state.
+
+Gang operators (one jitted step spanning the cohort's global mesh —
+DP/TP training) place one subtask per process when their parallelism
+equals ``num_processes``, which is exactly the layout the collective
+step requires.
+
+The gradient plane is untouched: XLA collectives over ICI/DCN inside
+compiled steps.  This module moves host-side records only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import typing
+
+from flink_tensorflow_tpu.core.graph import DataflowGraph, Transformation
+from flink_tensorflow_tpu.core.operators import StateNotRescalable
+from flink_tensorflow_tpu.core.runtime import LocalExecutor
+from flink_tensorflow_tpu.core.shuffle import RemoteChannelWriter, ShuffleServer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Cohort membership + record-plane endpoints for one process.
+
+    ``peers[p]`` is the ``"host:port"`` shuffle endpoint of process
+    ``p``; every process receives the same list and its own index.
+    """
+
+    process_index: int
+    num_processes: int
+    peers: typing.Tuple[str, ...]
+    #: Local interface the shuffle server binds (the advertised address
+    #: stays ``peers[process_index]``).
+    bind: str = "0.0.0.0"
+    connect_timeout_s: float = 60.0
+
+    def validate(self) -> "DistributedConfig":
+        if self.num_processes < 1:
+            raise ValueError(f"num_processes must be >= 1, got {self.num_processes}")
+        if not 0 <= self.process_index < self.num_processes:
+            raise ValueError(
+                f"process_index {self.process_index} out of range "
+                f"[0, {self.num_processes})"
+            )
+        if len(self.peers) != self.num_processes:
+            raise ValueError(
+                f"peers has {len(self.peers)} entries for "
+                f"{self.num_processes} processes"
+            )
+        for peer in self.peers:
+            host, _, port = peer.rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(f"peer {peer!r} is not 'host:port'")
+        if self.connect_timeout_s <= 0:
+            raise ValueError("connect_timeout_s must be > 0")
+        return self
+
+    def endpoint(self, process_index: int) -> typing.Tuple[str, int]:
+        host, _, port = self.peers[process_index].rpartition(":")
+        return host, int(port)
+
+    def process_checkpoint_dir(self, base: str,
+                               process_index: typing.Optional[int] = None) -> str:
+        """Per-process shard directory under a (possibly shared) base.
+
+        Cohort processes may point at ONE durable directory (the Flink
+        shared-storage model); without namespacing, each process's
+        ``write_checkpoint`` (rmtree + replace) would destroy its
+        peers' shards for the same checkpoint id AFTER the global gate
+        committed — an unrestorable checkpoint behind committed 2PC
+        output.  Every framework path (persist, restore, restart
+        strategy) routes through this helper."""
+        import os
+
+        idx = self.process_index if process_index is None else process_index
+        return os.path.join(base, f"proc-{idx:05d}")
+
+
+def process_of_subtask(subtask_index: int, num_processes: int) -> int:
+    """Deterministic placement: subtask i -> process i % P.  Identical on
+    every process (the cluster-wide channel layout depends on it), and
+    it gives gang operators with parallelism == P one subtask per
+    process."""
+    return subtask_index % num_processes
+
+
+class DistributedExecutor(LocalExecutor):
+    """LocalExecutor whose plan spans a process cohort via the shuffle."""
+
+    def __init__(self, graph: DataflowGraph, *,
+                 distributed: DistributedConfig, **kwargs):
+        self.dist = distributed.validate()
+        _, my_port = self.dist.endpoint(self.dist.process_index)
+        self._server = ShuffleServer(
+            self.dist.bind, my_port, on_error=self._transport_error,
+            on_control=self._on_control,
+        )
+        self._remote_writers: typing.List[RemoteChannelWriter] = []
+        #: Global 2PC commit point: checkpoint id -> processes that have
+        #: reported their shard durable.
+        self._durable_acks: typing.Dict[int, typing.Set[int]] = {}
+        self._durable_cv = threading.Condition()
+        #: Control channels to peers (lazy; used only by the single
+        #: persist worker thread).
+        self._control_writers: typing.Dict[int, RemoteChannelWriter] = {}
+        if kwargs.get("checkpoint_every_n") is None and (
+                kwargs.get("checkpoint_dir") is not None):
+            raise ValueError(
+                "distributed checkpointing requires count-based triggers "
+                "(checkpoint.every_n_records): barrier ids must be a "
+                "deterministic function of the stream so every process "
+                "cuts the same snapshot"
+            )
+        try:
+            super().__init__(graph, **kwargs)
+        except BaseException:
+            self._server.close(join=False)
+            raise
+        self.coordinator.lazy_register = True
+        self.coordinator.commit_gate = self._global_commit_gate
+        #: Processes owning >= 1 subtask under round-robin placement —
+        #: exactly those whose durability report a commit must await
+        #: (p owns subtask p of any transformation with parallelism > p).
+        max_par = max((t.parallelism for t in graph.transformations), default=0)
+        self._participants = frozenset(
+            p for p in range(self.dist.num_processes) if p < max_par
+        )
+        for st in self.subtasks:
+            if st.gate is not None:
+                self._server.register_gate(st.t.name, st.index, st.gate)
+        self._server.start()
+
+    # -- placement ------------------------------------------------------
+    def _owns_subtask(self, t: Transformation, index: int) -> bool:
+        return process_of_subtask(index, self.dist.num_processes) == self.dist.process_index
+
+    def _remote_writer(self, t: Transformation, subtask_index: int, channel_idx: int):
+        peer = process_of_subtask(subtask_index, self.dist.num_processes)
+        host, port = self.dist.endpoint(peer)
+        writer = RemoteChannelWriter(
+            host, port, t.name, subtask_index, channel_idx,
+            connect_timeout_s=self.dist.connect_timeout_s,
+        )
+        self._remote_writers.append(writer)
+        return writer
+
+    # -- global 2PC commit point -----------------------------------------
+    def _on_control(self, sender: int, message: typing.Any) -> None:
+        kind, cid = message[0], message[1]
+        if kind != "ckpt_durable":
+            logger.warning("unknown control message %r from %d", kind, sender)
+            return
+        with self._durable_cv:
+            self._durable_acks.setdefault(cid, set()).add(sender)
+            self._durable_cv.notify_all()
+
+    def _global_commit_gate(self, checkpoint_id: int) -> bool:
+        """Called by the coordinator after the LOCAL shard of
+        ``checkpoint_id`` is durable: announce it to the cohort and wait
+        until every participating process has announced the same.  Only
+        then may 2PC sinks promote — a commit bound to a checkpoint some
+        peer never cut would be rewound by the cohort's
+        latest-common-checkpoint restore.
+
+        Returns False (withholding the commit signal) on timeout,
+        cancellation, or peer loss; the staged transactions then promote
+        via a later checkpoint, a clean finish, or restore-time recovery.
+        """
+        me = self.dist.process_index
+        announcement = ("ckpt_durable", checkpoint_id, me)
+        for p in sorted(self._participants - {me}):
+            writer = self._control_writers.get(p)
+            if writer is None:
+                host, port = self.dist.endpoint(p)
+                writer = RemoteChannelWriter(
+                    host, port, ShuffleServer.CONTROL_TASK, me, 0,
+                    connect_timeout_s=self.dist.connect_timeout_s,
+                )
+                self._control_writers[p] = writer
+            try:
+                writer.write(announcement)
+            except (OSError, TimeoutError):
+                logger.warning(
+                    "could not announce checkpoint %d durability to peer %d",
+                    checkpoint_id, p, exc_info=True,
+                )
+                return False
+        deadline = time.monotonic() + self.checkpoint_timeout_s
+        with self._durable_cv:
+            try:
+                self._durable_acks.setdefault(checkpoint_id, set()).add(me)
+                while not (self._participants <= self._durable_acks[checkpoint_id]):
+                    if self.cancelled.is_set():
+                        return False
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        logger.warning(
+                            "checkpoint %d not globally durable within %.0fs "
+                            "(have %s of %s) — withholding 2PC commit signal",
+                            checkpoint_id, self.checkpoint_timeout_s,
+                            sorted(self._durable_acks[checkpoint_id]),
+                            sorted(self._participants),
+                        )
+                        return False
+                    # Releases the lock while waiting — peer announcements
+                    # land in _on_control under the same cv.
+                    self._durable_cv.wait(timeout=min(0.2, remaining))
+            finally:
+                # Reap this id AND anything older on every exit path —
+                # gates run in checkpoint-id order, so entries <= this id
+                # (timed-out gates, straggler announcements) are dead;
+                # without the sweep they would accumulate forever.
+                for cid in [c for c in self._durable_acks if c <= checkpoint_id]:
+                    del self._durable_acks[cid]
+        return True
+
+    # -- failure / teardown ---------------------------------------------
+    def _transport_error(self, exc: BaseException) -> None:
+        """A peer connection died before end-of-partition: the upstream
+        process is gone — fail the job (the cohort supervisor's restart
+        protocol takes over from there)."""
+        with self._error_lock:
+            if self._error is None:
+                self._error = exc
+        logger.error("record-plane transport failed", exc_info=exc)
+        self.cancel()
+
+    def cancel(self) -> None:
+        super().cancel()
+        # Unblock writers stuck in sendall, readers stuck in recv, and
+        # the persist thread waiting on the global commit gate.
+        # join=False: cancel may run on a shuffle reader thread (via
+        # _transport_error) — joining would self-deadlock.
+        # Snapshot the dicts: the persist thread inserts control writers
+        # concurrently (lazy creation inside the commit gate).
+        for w in list(self._remote_writers):
+            w.close()
+        for w in list(self._control_writers.values()):
+            w.close()
+        self._server.close(join=False)
+        with self._durable_cv:
+            self._durable_cv.notify_all()
+
+    def join(self, timeout: typing.Optional[float] = None) -> None:
+        try:
+            super().join(timeout)
+        finally:
+            for w in list(self._remote_writers):
+                w.close()
+            for w in list(self._control_writers.values()):
+                w.close()
+            self._server.close()
+
+    # -- restore ---------------------------------------------------------
+    def restore(self, snapshots, from_checkpoint_id=None) -> None:
+        local_counts: typing.Dict[str, int] = {}
+        for st in self.subtasks:
+            local_counts[st.t.name] = local_counts.get(st.t.name, 0) + 1
+        for task, snaps in snapshots.items():
+            if task == "__job__":
+                continue
+            expected = local_counts.get(task)
+            if expected is not None and len(snaps) != expected:
+                raise StateNotRescalable(
+                    f"checkpoint shard for {task!r} holds {len(snaps)} "
+                    f"subtask states but this process owns {expected} — "
+                    "the cohort size (num_processes) changed across the "
+                    "restore; peer-held state cannot be redistributed "
+                    "from one process's shard. Restore with the original "
+                    "cohort shape."
+                )
+        super().restore(snapshots, from_checkpoint_id)

@@ -220,22 +220,41 @@ class StreamExecutionEnvironment:
         )
         return DataStream(self, t)
 
+    def set_distributed(self, distributed) -> "StreamExecutionEnvironment":
+        """Join a process cohort: subtasks spread over the cohort and
+        keyed/rebalance edges span processes through the record plane
+        (core.distributed.DistributedConfig)."""
+        return self.configure(distributed=distributed)
+
     # -- execution ---------------------------------------------------------
+    def _resolve_checkpoint_location(self, d: typing.Optional[str]) -> typing.Optional[str]:
+        """Distributed jobs shard one (possibly shared) checkpoint dir
+        per process — see DistributedConfig.process_checkpoint_dir."""
+        if d is not None and self.config.distributed is not None:
+            return self.config.distributed.process_checkpoint_dir(d)
+        return d
+
     def _make_executor(self) -> LocalExecutor:
         cfg = self.config.validate()
-        return LocalExecutor(
-            self.graph,
+        common = dict(
             channel_capacity=cfg.channel_capacity,
             metric_registry=self.metric_registry,
             device_provider=cfg.device_provider,
             mesh=cfg.mesh,
             job_config=dict(cfg.user_params),
             source_throttle_s=cfg.source_throttle_s,
-            checkpoint_dir=cfg.checkpoint.dir,
+            checkpoint_dir=self._resolve_checkpoint_location(cfg.checkpoint.dir),
             checkpoint_every_n=cfg.checkpoint.every_n_records,
             checkpoint_timeout_s=cfg.checkpoint.timeout_s,
             max_parallelism=cfg.max_parallelism,
         )
+        if cfg.distributed is not None:
+            from flink_tensorflow_tpu.core.distributed import DistributedExecutor
+
+            return DistributedExecutor(
+                self.graph, distributed=cfg.distributed, **common
+            )
+        return LocalExecutor(self.graph, **common)
 
     def execute(
         self,
@@ -288,7 +307,8 @@ class StreamExecutionEnvironment:
                 # (or a clean replay when none was given).
                 from flink_tensorflow_tpu.checkpoint.store import latest_checkpoint_id
 
-                new_id = latest_checkpoint_id(self.checkpoint_dir)
+                new_id = latest_checkpoint_id(
+                    self._resolve_checkpoint_location(self.checkpoint_dir))
                 if new_id is not None:
                     restore, restore_id = self.checkpoint_dir, new_id
                 else:
@@ -306,7 +326,10 @@ class StreamExecutionEnvironment:
         if restore_from is not None:
             from flink_tensorflow_tpu.checkpoint.store import read_checkpoint
 
-            cid, snapshots = read_checkpoint(restore_from, restore_checkpoint_id)
+            cid, snapshots = read_checkpoint(
+                self._resolve_checkpoint_location(restore_from),
+                restore_checkpoint_id,
+            )
             executor.restore(snapshots, from_checkpoint_id=cid)
         executor.start()
         return JobHandle(executor)

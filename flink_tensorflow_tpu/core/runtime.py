@@ -307,10 +307,15 @@ class LocalExecutor:
             gate_size[t.id] = base
             edge_of_channel[t.id] = channel_edges
 
-        # Pass 2: instantiate subtasks and gates.
+        # Pass 2: instantiate subtasks and gates.  A distributed executor
+        # owns only the subtasks placed on this process (_owns_subtask);
+        # the identical graph is built on every process, so channel
+        # layout and subtask indices agree cluster-wide.
         for t in order:
             subtasks = []
             for i in range(t.parallelism):
+                if not self._owns_subtask(t, i):
+                    continue
                 operator = t.operator_factory()
                 gate = None
                 if not t.is_source:
@@ -335,12 +340,19 @@ class LocalExecutor:
                 for d, edge_idx, edge in downstream:
                     base = channel_base[(d.id, edge_idx)]
                     if isinstance(edge.partitioner, ForwardPartitioner):
-                        writers = [ChannelWriter(gates[(d.id, st.index)], base)]
+                        targets = [(st.index, base)]
                     else:
-                        writers = [
-                            ChannelWriter(gates[(d.id, j)], base + st.index)
-                            for j in range(d.parallelism)
-                        ]
+                        targets = [(j, base + st.index) for j in range(d.parallelism)]
+                    # A downstream subtask without a local gate lives on a
+                    # peer process: the writer becomes a remote channel of
+                    # the record plane (records AND barriers flow through
+                    # it — alignment spans processes).
+                    writers = [
+                        ChannelWriter(gates[(d.id, j)], ch)
+                        if (d.id, j) in gates
+                        else self._remote_writer(d, j, ch)
+                        for j, ch in targets
+                    ]
                     # Stateful partitioners (e.g. rebalance round-robin) must
                     # not be shared across upstream subtask threads.
                     import copy
@@ -363,6 +375,17 @@ class LocalExecutor:
                 )
                 st.operator.setup(ctx, st.output, state)
                 self.subtasks.append(st)
+
+    # --- placement hooks (overridden by DistributedExecutor) -------------
+    def _owns_subtask(self, t: Transformation, index: int) -> bool:
+        """Whether subtask ``index`` of ``t`` runs in this process."""
+        return True
+
+    def _remote_writer(self, t: Transformation, subtask_index: int, channel_idx: int):
+        raise RuntimeError(
+            f"no gate for {t.name}.{subtask_index} — local executor owns "
+            "every subtask, so this is a plan-construction bug"
+        )
 
     # --- restore ---------------------------------------------------------
     def restore(

@@ -1,0 +1,307 @@
+"""Cross-process record plane — the Netty-shuffle equivalent.
+
+The reference's record plane is Flink's credit-based Netty shuffle: a
+``keyBy`` edge spans TaskManagers transparently, and checkpoint barriers
+flow THROUGH the network channels so alignment (and therefore
+exactly-once) works cluster-wide (SURVEY.md §1 L1, §2 "Distributed
+communication backend").  This module is that plane for the TPU
+framework's host-side record traffic:
+
+- :class:`ShuffleServer` — one per process: accepts peer connections and
+  feeds the local subtasks' :class:`~...channels.InputGate`\\ s.  A
+  connection handshakes with its destination ``(task, subtask,
+  channel)`` route, then streams frames.
+- :class:`RemoteChannelWriter` — the :class:`ChannelWriter` contract
+  over one TCP connection.  Per-channel FIFO comes from TCP ordering +
+  the single upstream writer thread, exactly like the in-process queue.
+
+EVERY stream element crosses the wire — records, watermarks, checkpoint
+barriers, end-of-partition — so downstream barrier alignment is real
+alignment, not a convention.  Backpressure is the transport's: the
+receiving gate's bounded queue stalls the reader thread, the kernel TCP
+window fills, and the remote ``sendall`` blocks.
+
+Gradients never touch this plane: they ride XLA collectives over
+ICI/DCN inside compiled steps (SURVEY.md §2).  This plane is the
+reference's *record* shuffle only.
+
+Framing: 4-byte little-endian length + pickle (protocol 5 — numpy
+record payloads serialize as buffer views, not byte copies).  The wire
+is trusted (cluster-internal, same codebase both ends), matching the
+reference's Java-serialization posture inside a Flink cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+import typing
+
+from flink_tensorflow_tpu.core import elements as el
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.channels import InputGate
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 1 << 30
+
+
+def _recv_exact(conn: socket.socket, n: int) -> typing.Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF at a frame boundary."""
+    chunks: typing.List[bytes] = []
+    got = 0
+    while got < n:
+        chunk = conn.recv(min(1 << 20, n - got))
+        if not chunk:
+            if got:
+                raise ConnectionError("peer closed mid-frame (stream truncated)")
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _send_frame(conn: socket.socket, payload: bytes) -> None:
+    header = _LEN.pack(len(payload))
+    if len(payload) < (1 << 16):
+        conn.sendall(header + payload)  # one syscall for small frames
+    else:
+        # Large record frames: don't copy megabytes just to prepend a
+        # 4-byte header (the writer is single-threaded per connection,
+        # so two sendalls cannot interleave).
+        conn.sendall(header)
+        conn.sendall(payload)
+
+
+def _recv_frame(conn: socket.socket) -> typing.Optional[bytes]:
+    head = _recv_exact(conn, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({length} bytes)")
+    payload = _recv_exact(conn, length)
+    if payload is None:
+        raise ConnectionError("peer closed between header and body")
+    return payload
+
+
+class ShuffleServer:
+    """Per-process receiving endpoint of the record plane.
+
+    Lifecycle: construct (binds immediately so the advertised port is
+    owned before peers race to connect) -> ``register_gate`` for every
+    local subtask during plan construction -> ``start`` -> ``close``.
+
+    A reader whose connection dies BEFORE delivering EndOfPartition
+    reports through ``on_error`` (the executor fails the job — upstream
+    process loss must surface as a failure, not as a silently truncated
+    stream); EOF after EOP is the clean shutdown.
+    """
+
+    #: Handshake task name for coordinator control messages (checkpoint
+    #: durability announcements) — not a data route, no gate, no EOP.
+    CONTROL_TASK = "__control__"
+
+    def __init__(self, bind: str = "0.0.0.0", port: int = 0, *,
+                 on_error: typing.Optional[typing.Callable[[BaseException], None]] = None,
+                 on_control: typing.Optional[typing.Callable[[int, typing.Any], None]] = None):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind, port))
+        self._listener.listen(128)
+        self.port: int = self._listener.getsockname()[1]
+        self.on_error = on_error
+        self.on_control = on_control
+        self._gates: typing.Dict[typing.Tuple[str, int], "InputGate"] = {}
+        self._threads: typing.List[threading.Thread] = []
+        self._conns: typing.List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._accept_thread: typing.Optional[threading.Thread] = None
+
+    def register_gate(self, task: str, subtask_index: int, gate: "InputGate") -> None:
+        self._gates[(task, subtask_index)] = gate
+
+    def start(self) -> None:
+        self._listener.settimeout(0.25)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"shuffle-accept:{self.port}", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                if self._stop.is_set():
+                    conn.close()
+                    return
+                self._conns.append(conn)
+            t = threading.Thread(target=self._reader, args=(conn,), daemon=True)
+            t.start()
+            with self._lock:
+                self._threads.append(t)
+
+    def _reader(self, conn: socket.socket) -> None:
+        route = "<handshake>"
+        try:
+            hello = _recv_frame(conn)
+            if hello is None:
+                return  # peer probed and left before the handshake
+            task, subtask_index, channel_idx = pickle.loads(hello)
+            route = f"{task}.{subtask_index}[ch{channel_idx}]"
+            if task == self.CONTROL_TASK:
+                # Coordinator control plane: subtask_index is the SENDER
+                # process; frames are opaque control messages.  EOF is a
+                # clean close (no EndOfPartition on control routes).
+                while True:
+                    payload = _recv_frame(conn)
+                    if payload is None:
+                        return
+                    if self.on_control is not None:
+                        self.on_control(subtask_index, pickle.loads(payload))
+            gate = self._gates.get((task, subtask_index))
+            if gate is None:
+                raise ConnectionError(
+                    f"no local gate for route {route} — placement mismatch "
+                    "(peers must build the identical job graph)"
+                )
+            saw_eop = False
+            while True:
+                payload = _recv_frame(conn)
+                if payload is None:
+                    break
+                element = pickle.loads(payload)
+                saw_eop = isinstance(element, el.EndOfPartition)
+                gate.put(channel_idx, element)
+            if not saw_eop and not self._stop.is_set():
+                raise ConnectionError(
+                    f"peer for {route} disconnected before EndOfPartition "
+                    "(upstream process lost)"
+                )
+        except BaseException as exc:  # noqa: BLE001 — relayed to the executor
+            if not self._stop.is_set():
+                logger.error("shuffle reader %s failed", route, exc_info=exc)
+                if self.on_error is not None:
+                    self.on_error(exc)
+        finally:
+            conn.close()
+
+    def close(self, join: bool = True) -> None:
+        """``join=False`` skips waiting for reader threads — required when
+        closing from a reader thread itself (error path) where a join
+        would self-deadlock."""
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+            threads, self._threads = self._threads, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if not join:
+            return
+        current = threading.current_thread()
+        if self._accept_thread is not None and self._accept_thread is not current:
+            self._accept_thread.join(timeout=2.0)
+        for t in threads:
+            if t is not current:
+                t.join(timeout=2.0)
+
+
+class RemoteChannelWriter:
+    """ChannelWriter contract over TCP to a peer's ShuffleServer.
+
+    One connection per writer = per (upstream subtask, downstream
+    subtask, edge): per-channel FIFO for free.  Connects lazily on first
+    write with a retry window (cohort processes start in any order).
+    After ``close`` writes drop silently — the same teardown semantics
+    as the in-process gate.
+    """
+
+    def __init__(self, host: str, port: int, task: str, subtask_index: int,
+                 channel_idx: int, *, connect_timeout_s: float = 60.0):
+        self.host = host
+        self.port = port
+        self.task = task
+        self.subtask_index = subtask_index
+        self.channel_idx = channel_idx
+        self.connect_timeout_s = connect_timeout_s
+        self._sock: typing.Optional[socket.socket] = None
+        self._closed = False
+
+    def _connect(self) -> None:
+        deadline = time.monotonic() + self.connect_timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"shuffle peer {self.host}:{self.port} unreachable "
+                    f"within {self.connect_timeout_s}s"
+                )
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=remaining
+                )
+                break
+            except OSError:
+                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_frame(self._sock, pickle.dumps(
+            (self.task, self.subtask_index, self.channel_idx),
+            protocol=pickle.HIGHEST_PROTOCOL,
+        ))
+
+    def write(self, element: el.StreamElement) -> None:
+        if self._closed:
+            return  # job torn down: drop, like InputGate.put after close
+        if self._sock is None:
+            self._connect()
+        try:
+            _send_frame(self._sock, pickle.dumps(
+                element, protocol=pickle.HIGHEST_PROTOCOL))
+        except OSError:
+            # Drop the dead socket so a LATER write reconnects instead of
+            # failing forever on the cached fd (control writers are
+            # long-lived across checkpoints; a transient reset must not
+            # wedge every subsequent commit gate).
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            if self._closed:
+                return
+            raise  # peer loss surfaces as subtask failure -> job failure
+
+    def close(self) -> None:
+        self._closed = True
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

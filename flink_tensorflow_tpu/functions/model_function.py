@@ -138,13 +138,7 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
         if micro_batch < 1:
             raise ValueError(f"micro_batch must be >= 1, got {micro_batch}")
         if "policy" not in kw:
-            sizes = []
-            b = 1
-            while b < micro_batch:
-                sizes.append(b)
-                b *= 2
-            sizes.append(micro_batch)
-            kw["policy"] = BucketPolicy(batch=BucketLadder(sizes))
+            kw["policy"] = BucketPolicy(batch=BucketLadder.up_to(micro_batch))
         super().__init__(model, method, **kw)
         if pipeline_depth is None:
             pipeline_depth = max(2, 2 * self._transfer_lanes)

@@ -94,6 +94,44 @@ def _env_int(name: str) -> typing.Optional[int]:
     return int(v) if v is not None else None
 
 
+def hybrid_device_array(
+    spec: MeshSpec, devices: typing.Sequence, *, dcn_axis: str = "pipe"
+):
+    """Physical device layout for :func:`global_mesh` — split out so the
+    multi-slice branch is unit-testable with stub devices carrying
+    ``slice_index``/``process_index`` (real multi-slice hardware is not
+    available in CI).  Returns the ``[axis...]``-shaped device ndarray.
+    """
+    from jax.experimental import mesh_utils
+
+    names = spec.axis_names
+    shape = tuple(spec.axes[a] for a in names)
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f"mesh {dict(spec.axes)} needs {spec.num_devices} devices, "
+            f"cohort has {len(devices)}"
+        )
+    num_slices = max((getattr(d, "slice_index", 0) for d in devices), default=0) + 1
+    if num_slices > 1:
+        dcn = dcn_axis if dcn_axis in names else names[0]
+        if spec.axes[dcn] % num_slices != 0:
+            raise ValueError(
+                f"DCN axis {dcn!r} has size {spec.axes[dcn]} which does not "
+                f"divide over {num_slices} slices"
+            )
+        # The DCN axis spans the slices; any remaining extent of that
+        # axis (size/num_slices) stays inside each slice over ICI.
+        dcn_shape = tuple(num_slices if a == dcn else 1 for a in names)
+        ici_shape = tuple(
+            spec.axes[a] if a != dcn else spec.axes[a] // num_slices
+            for a in names
+        )
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
+        )
+    return mesh_utils.create_device_mesh(shape, devices=devices)
+
+
 def global_mesh(axes: typing.Mapping[str, int], *, dcn_axis: str = "pipe"):
     """Build a mesh over ALL hosts' devices.
 
@@ -104,24 +142,7 @@ def global_mesh(axes: typing.Mapping[str, int], *, dcn_axis: str = "pipe"):
     ``create_hybrid_device_mesh`` handles the physical layout).
     """
     import jax
-    from jax.experimental import mesh_utils
 
     spec = MeshSpec(axes)
-    names = spec.axis_names
-    shape = tuple(spec.axes[a] for a in names)
-    devices = jax.devices()
-    if spec.num_devices != len(devices):
-        raise ValueError(
-            f"mesh {dict(axes)} needs {spec.num_devices} devices, cohort has {len(devices)}"
-        )
-    num_slices = max((getattr(d, "slice_index", 0) for d in devices), default=0) + 1
-    if num_slices > 1:
-        dcn = dcn_axis if dcn_axis in names else names[0]
-        dcn_shape = tuple(spec.axes[a] if a == dcn else 1 for a in names)
-        ici_shape = tuple(spec.axes[a] if a != dcn else 1 for a in names)
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=devices
-        )
-    else:
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    return jax.sharding.Mesh(dev_array, names)
+    dev_array = hybrid_device_array(spec, jax.devices(), dcn_axis=dcn_axis)
+    return jax.sharding.Mesh(dev_array, spec.axis_names)

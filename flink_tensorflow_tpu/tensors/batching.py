@@ -54,6 +54,20 @@ class BucketLadder:
             raise ValueError(f"size {n} exceeds largest bucket {self.sizes[-1]}")
         return self.sizes[i]
 
+    @classmethod
+    def up_to(cls, cap: int) -> "BucketLadder":
+        """Powers of two up to ``cap``, with ``cap`` itself as the top
+        rung even when it isn't a power of two — the capped micro-batch
+        ladder (transparent map batching, open-loop service buckets)."""
+        if cap < 1:
+            raise ValueError(f"cap must be >= 1, got {cap}")
+        sizes, s = [], 1
+        while s < cap:
+            sizes.append(s)
+            s *= 2
+        sizes.append(cap)
+        return cls(sizes)
+
 
 @dataclasses.dataclass(frozen=True)
 class BucketPolicy:

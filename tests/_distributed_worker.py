@@ -1,0 +1,87 @@
+"""Worker process for the distributed record-plane tests.
+
+Runs ONE process of a 2-process cohort executing
+``source -> key_by -> keyed sum (parallelism 2) -> 2PC file sink`` with
+NO RemoteSink/RemoteSource anywhere: subtask placement and the
+cross-process channels come from the record plane itself
+(core/distributed.py).  The keyed edge spans processes — records whose
+key group routes to the peer's subtask cross the shuffle, and
+checkpoint barriers flow through the same channels.
+"""
+
+import argparse
+
+from flink_tensorflow_tpu.utils.platform import force_cpu
+
+force_cpu(1)
+
+import numpy as np  # noqa: E402
+
+from flink_tensorflow_tpu import DistributedConfig, StreamExecutionEnvironment  # noqa: E402
+from flink_tensorflow_tpu.core import functions as fn  # noqa: E402
+from flink_tensorflow_tpu.core.state import StateDescriptor  # noqa: E402
+from flink_tensorflow_tpu.io.files import ExactlyOnceRecordFileSink  # noqa: E402
+from flink_tensorflow_tpu.tensors import TensorValue  # noqa: E402
+
+SUM = StateDescriptor("sum", default_factory=lambda: 0)
+NUM_KEYS = 4
+
+
+class KeyedSum(fn.ProcessFunction):
+    """Running per-key sum in keyed state; emits (key, i, sum) per record."""
+
+    def process_element(self, value, ctx, out):
+        state = ctx.state(SUM)
+        cur = state.value() + int(value)
+        state.update(cur)
+        out.collect(TensorValue(
+            {"v": np.int64(cur)},
+            {"key": int(ctx.current_key), "i": int(value)},
+        ))
+
+
+def expected_emissions(n):
+    """The exactly-once output: one (key, i, running_sum) per record."""
+    sums = {k: 0 for k in range(NUM_KEYS)}
+    out = []
+    for i in range(n):
+        k = i % NUM_KEYS
+        sums[k] += i
+        out.append((k, i, sums[k]))
+    return sorted(out)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--index", type=int, required=True)
+    p.add_argument("--ports", required=True, help="comma-separated, one per process")
+    p.add_argument("--out", required=True)
+    p.add_argument("--chk", default=None)
+    p.add_argument("--n", type=int, default=80)
+    p.add_argument("--every", type=int, default=20)
+    p.add_argument("--restore-id", type=int, default=-1)
+    p.add_argument("--throttle", type=float, default=0.0)
+    args = p.parse_args()
+
+    ports = [int(x) for x in args.ports.split(",")]
+    peers = tuple(f"127.0.0.1:{pt}" for pt in ports)
+    env = StreamExecutionEnvironment(parallelism=1)
+    env.configure(source_throttle_s=args.throttle)
+    env.set_distributed(DistributedConfig(args.index, len(ports), peers,
+                                          connect_timeout_s=30.0))
+    if args.chk:
+        env.enable_checkpointing(args.chk, every_n_records=args.every)
+    (
+        env.from_collection(list(range(args.n)), parallelism=1)
+        .key_by(lambda x: x % NUM_KEYS)
+        .process(KeyedSum(), name="keyed_sum", parallelism=2)
+        .add_sink(ExactlyOnceRecordFileSink(args.out), name="sink", parallelism=1)
+    )
+    kw = {}
+    if args.restore_id >= 0:
+        kw = dict(restore_from=args.chk, restore_checkpoint_id=args.restore_id)
+    env.execute("dist-plane", timeout=180, **kw)
+
+
+if __name__ == "__main__":
+    main()

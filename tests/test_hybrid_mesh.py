@@ -1,0 +1,92 @@
+"""Hybrid multi-slice mesh layout (VERDICT r2 weak #7 / next-round #7).
+
+Real multi-slice TPU hardware is unavailable in CI, so the DCN-axis
+layout math of ``global_mesh``'s ``num_slices > 1`` branch is pinned
+with stub devices carrying ``slice_index``/``process_index``/``coords``:
+the declared DCN axis must span slices (one slice per index along it)
+while every other axis stays inside a slice (ICI)."""
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu.parallel.mesh import MeshSpec
+from flink_tensorflow_tpu.parallel.multihost import hybrid_device_array
+
+
+class StubDevice:
+    """Minimal shape mesh_utils needs: TPU platform, physical coords
+    within the slice, slice/process identity."""
+
+    def __init__(self, id, process_index, slice_index, coords):
+        self.id = id
+        self.process_index = process_index
+        self.slice_index = slice_index
+        self.platform = "tpu"
+        self.device_kind = "stub-tpu"
+        self.coords = coords
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"D{self.id}(s{self.slice_index})"
+
+
+def two_slices(per_slice=4):
+    devs = []
+    for s in range(2):
+        for i in range(per_slice):
+            devs.append(StubDevice(s * per_slice + i, s, s, (i % 2, i // 2, 0)))
+    return devs
+
+
+def slice_of(arr):
+    return np.vectorize(lambda d: d.slice_index)(arr)
+
+
+class TestHybridDeviceArray:
+    def test_declared_dcn_axis_spans_slices(self):
+        """{pipe: 2, data: 4} over 2 slices: pipe rides DCN — each pipe
+        index is one whole slice; data stays inside the slice (ICI)."""
+        arr = hybrid_device_array(MeshSpec({"pipe": 2, "data": 4}), two_slices())
+        assert arr.shape == (2, 4)
+        layout = slice_of(arr)
+        # Row p is entirely slice p; columns (data axis) never cross DCN.
+        np.testing.assert_array_equal(layout, [[0] * 4, [1] * 4])
+
+    def test_fallback_dcn_axis_is_outermost(self):
+        """Without the default 'pipe' axis, the OUTERMOST declared axis
+        takes the DCN split: {data: 8} over 2 slices -> the data axis
+        splits into two contiguous per-slice halves."""
+        arr = hybrid_device_array(MeshSpec({"data": 8}), two_slices())
+        assert arr.shape == (8,)
+        np.testing.assert_array_equal(slice_of(arr), [0] * 4 + [1] * 4)
+
+    def test_dcn_axis_larger_than_slices_keeps_ici_remainder(self):
+        """{data: 4, model: 2} with dcn_axis='data' over 2 slices: data
+        contributes 2 over DCN x 2 over ICI; no device crosses a slice
+        boundary except along data's DCN half."""
+        arr = hybrid_device_array(
+            MeshSpec({"data": 4, "model": 2}), two_slices(), dcn_axis="data")
+        assert arr.shape == (4, 2)
+        layout = slice_of(arr)
+        # data indices 0-1 in slice 0, 2-3 in slice 1 (2-way DCN split).
+        np.testing.assert_array_equal(layout[:2], np.zeros((2, 2), int))
+        np.testing.assert_array_equal(layout[2:], np.ones((2, 2), int))
+
+    def test_indivisible_dcn_axis_rejected(self):
+        devs = two_slices(3)  # 2 slices x 3 devices
+        with pytest.raises(ValueError, match="does not divide"):
+            hybrid_device_array(MeshSpec({"pipe": 3, "data": 2}), devs)
+
+    def test_wrong_device_count_rejected(self):
+        with pytest.raises(ValueError, match="needs"):
+            hybrid_device_array(MeshSpec({"data": 4}), two_slices())
+
+    def test_single_slice_uses_plain_mesh(self):
+        devs = [StubDevice(i, 0, 0, (i % 2, i // 2, 0)) for i in range(4)]
+        arr = hybrid_device_array(MeshSpec({"data": 4}), devs)
+        assert arr.shape == (4,)
+        assert sorted(d.id for d in arr.ravel()) == [0, 1, 2, 3]
+
+    def test_every_device_used_exactly_once(self):
+        arr = hybrid_device_array(MeshSpec({"pipe": 2, "data": 4}), two_slices())
+        assert sorted(d.id for d in arr.ravel()) == list(range(8))

@@ -1,0 +1,177 @@
+"""Multi-MB TF artifacts with weights-as-params (VERDICT r2 next-round #5).
+
+r2's TF loaders baked captured weights into the lowered graph as
+constants and were tested on toy graphs only.  These tests export a
+genuinely multi-MB SavedModel/frozen graph at setup, load it with
+``extract_weights=True``, and verify: the weights live in
+``Model.params`` (XLA executable ARGUMENTS — HBM-resident, reusable
+across calls), not in the executable as constants; outputs match TF
+exactly; compile time stays bounded; and the artifact streams through
+``ModelWindowFunction`` end to end.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment  # noqa: E402
+from flink_tensorflow_tpu.functions import ModelWindowFunction  # noqa: E402
+from flink_tensorflow_tpu.models.tf_loader import (  # noqa: E402
+    TFGraphDefLoader,
+    TFSavedModelLoader,
+)
+from flink_tensorflow_tpu.tensors import BucketPolicy, TensorValue  # noqa: E402
+
+DIM_IN, HIDDEN, DIM_OUT = 256, 4096, 64
+WEIGHT_BYTES = 4 * (DIM_IN * HIDDEN + HIDDEN + HIDDEN * DIM_OUT)  # ~5.3MB
+
+
+@pytest.fixture(scope="module")
+def big_savedmodel(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tfbig") / "mlp")
+
+    class Big(tf.Module):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.w1 = tf.Variable(
+                (rng.randn(DIM_IN, HIDDEN) / 16).astype(np.float32), name="w1")
+            self.b1 = tf.Variable(np.zeros(HIDDEN, np.float32), name="b1")
+            self.w2 = tf.Variable(
+                (rng.randn(HIDDEN, DIM_OUT) / 64).astype(np.float32), name="w2")
+
+        @tf.function(input_signature=[tf.TensorSpec([None, DIM_IN],
+                                                    tf.float32, name="x")])
+        def serve(self, x):
+            h = tf.nn.relu(x @ self.w1 + self.b1)
+            return {"y": h @ self.w2}
+
+    m = Big()
+    tf.saved_model.save(m, path, signatures={"serving_default": m.serve})
+    size = sum(
+        os.path.getsize(os.path.join(r, f))
+        for r, _, fs in os.walk(path) for f in fs
+    )
+    assert size > 4_000_000, f"fixture artifact too small ({size} bytes)"
+    return path
+
+
+@pytest.fixture(scope="module")
+def reference(big_savedmodel):
+    sig = tf.saved_model.load(big_savedmodel).signatures["serving_default"]
+    x = np.random.RandomState(1).randn(8, DIM_IN).astype(np.float32)
+    return x, sig(x=tf.constant(x))["y"].numpy()
+
+
+class TestSavedModelWeightExtraction:
+    def test_params_hold_the_weights(self, big_savedmodel):
+        model = TFSavedModelLoader(big_savedmodel, extract_weights=True).load()
+        total = sum(np.asarray(v).nbytes for v in model.params.values())
+        # w1 and w2 clear the 64KB threshold; b1 (16KB) stays baked.
+        assert total >= 4 * (DIM_IN * HIDDEN + HIDDEN * DIM_OUT)
+        assert model.metadata["weights"] == "extracted_params"
+        # Name recovery: params keys are the original variable names.
+        assert {"w1", "w2"} <= set(model.params)
+
+    def test_outputs_match_tf_and_weights_are_arguments(
+            self, big_savedmodel, reference):
+        x, ref = reference
+        model = TFSavedModelLoader(big_savedmodel, extract_weights=True).load()
+        serve = model.method("serve").fn
+        f = jax.jit(lambda p, inp: serve(p, inp))
+        t0 = time.monotonic()
+        compiled = f.lower(model.params, {"x": x}).compile()
+        compile_s = time.monotonic() - t0
+        out = np.asarray(compiled(model.params, {"x": x})["y"])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+        # Bounded compile: a multi-MB artifact must not blow up lowering.
+        assert compile_s < 120, f"compile took {compile_s:.1f}s"
+        # The weights enter as executable ARGUMENTS (HBM params), not as
+        # baked literals: argument traffic must cover the weight bytes.
+        ma = compiled.memory_analysis()
+        assert ma.argument_size_in_bytes >= 4 * (DIM_IN * HIDDEN + HIDDEN * DIM_OUT)
+
+    def test_baked_path_embeds_weights_instead(self, big_savedmodel, reference):
+        """Control: default (baked) lowering feeds only the 8-row input —
+        the arguments are orders of magnitude smaller because the
+        weights sit inside the executable."""
+        x, ref = reference
+        model = TFSavedModelLoader(big_savedmodel).load()
+        assert model.params == {}
+        serve = model.method("serve").fn
+        compiled = jax.jit(lambda p, inp: serve(p, inp)).lower(
+            {}, {"x": x}).compile()
+        out = np.asarray(compiled({}, {"x": x})["y"])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+        ma = compiled.memory_analysis()
+        assert ma.argument_size_in_bytes < WEIGHT_BYTES / 4
+
+    def test_streams_through_model_window_function(self, big_savedmodel, reference):
+        """End to end: the multi-MB artifact as a stream operator, params
+        shipped to the device once at open()."""
+        x, ref = reference
+        model = TFSavedModelLoader(big_savedmodel, extract_weights=True).load()
+        records = [TensorValue({"x": x[i]}, {"i": i}) for i in range(len(x))]
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(records, parallelism=1)
+            .count_window(4)
+            .apply(ModelWindowFunction(model, policy=BucketPolicy(fixed_batch=4)))
+            .sink_to_list()
+        )
+        env.execute(timeout=300)
+        got = {r.meta["i"]: np.asarray(r["y"]) for r in results}
+        for i in range(len(x)):
+            np.testing.assert_allclose(got[i], ref[i], rtol=2e-4, atol=2e-4)
+
+
+class TestGraphDefWeightExtraction:
+    @pytest.fixture(scope="class")
+    def frozen_pb(self, big_savedmodel, tmp_path_factory):
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        loaded = tf.saved_model.load(big_savedmodel)  # keepalive: the
+        # ConcreteFunction holds weakrefs to its variables
+        sig = loaded.signatures["serving_default"]
+        frozen = convert_variables_to_constants_v2(sig)
+        path = str(tmp_path_factory.mktemp("pb") / "big.pb")
+        with open(path, "wb") as f:
+            f.write(frozen.graph.as_graph_def().SerializeToString())
+        out_name = frozen.outputs[0].name
+        assert os.path.getsize(path) > 4_000_000
+        return path, out_name
+
+    def test_frozen_graph_extraction_end_to_end(self, frozen_pb, reference):
+        x, ref = reference
+        path, out_name = frozen_pb
+        loader = TFGraphDefLoader(
+            path, inputs={"x": "x:0"}, outputs={"y": out_name},
+            extract_weights=True,
+        )
+        model = loader.load()
+        total = sum(np.asarray(v).nbytes for v in model.params.values())
+        assert total >= 4 * (DIM_IN * HIDDEN + HIDDEN * DIM_OUT)
+        serve = model.method("serve").fn
+        out = np.asarray(
+            jax.jit(serve)(model.params, {"x": x})["y"])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_threshold_keeps_small_consts_baked(self, frozen_pb, reference):
+        x, ref = reference
+        path, out_name = frozen_pb
+        huge_threshold = 1 << 30
+        loader = TFGraphDefLoader(
+            path, inputs={"x": "x:0"}, outputs={"y": out_name},
+            extract_weights=True, extract_min_bytes=huge_threshold,
+        )
+        model = loader.load()
+        assert model.params == {}  # nothing cleared the bar: fully baked
+        out = np.asarray(jax.jit(model.method("serve").fn)({}, {"x": x})["y"])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
