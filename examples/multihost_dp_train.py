@@ -1,24 +1,28 @@
-"""Multi-host data-parallel training with cohort supervision.
+"""Multi-host data-parallel training over the transparent record plane.
 
 The reference's cluster story (SURVEY.md §1 L1, §3.5): a JobManager
 schedules subtasks onto TaskManagers; DP training crosses processes via
-TF ClusterSpec + NCCL.  The TPU-native cohort (SURVEY.md §7 step 8):
+TF ClusterSpec + NCCL; records cross via Flink's network shuffle.  The
+TPU-native cohort (SURVEY.md §7 step 8) — ONE job graph, built
+identically on every process:
 
 - a **CohortSupervisor** (parent mode, the JobManager analogue) spawns N
   identical worker processes and restarts the whole cohort from the last
   COMMON checkpoint on any worker loss (XLA meshes cannot shrink live);
-- each **worker** joins the jax.distributed cohort, forms the global
-  mesh, and runs the SAME streaming job: its partition of the record
-  stream -> count windows of ``global_batch/N`` -> a gang
-  DPTrainWindowFunction whose pjit-ed step spans every host's devices
-  (gradient allreduce compiled by XLA, zero communication code here);
-- checkpoints use **count-based barriers** (``every_n_records``) so all
-  hosts snapshot at identical stream positions — the property that makes
-  per-host snapshots cohort-consistent;
-- after training, every worker ships its loss stream over the **remote
-  record plane** (RemoteSink -> fan-in RemoteSource on worker 0), which
-  aggregates them — the cross-process record exchange the reference does
-  with Flink's Netty shuffle.
+- each worker joins the jax.distributed cohort, forms the global mesh,
+  and executes the SAME job with ``env.set_distributed``: the
+  parallelism-N source partitions the logical stream (subtask w on
+  process w), count windows of ``global_batch/N`` feed the gang
+  **DPTrainWindowFunction** (parallelism N = one subtask per process, so
+  every process participates in the pjit-ed step; gradient allreduce
+  compiled by XLA, zero communication code here), and the loss stream
+  REBALANCES down to a parallelism-1 aggregation sink on process 0 —
+  the cross-host edge rides the record plane's barrier-carrying
+  channels, no RemoteSink/RemoteSource anywhere;
+- checkpoints use **count-based barriers** (``every_n_records``) into a
+  SHARED checkpoint directory (per-process shards are namespaced by the
+  framework); barriers cross processes through the shuffle channels and
+  the 2PC commit point is global durability.
 
 Run (2 processes, 8 virtual CPU devices total, one injected failure):
   python examples/multihost_dp_train.py --records-per-worker 48
@@ -54,7 +58,8 @@ def build_parser():
     p.add_argument("--worker", type=int, default=None)
     p.add_argument("--attempt", type=int, default=0)
     p.add_argument("--coordinator-port", type=int, default=None)
-    p.add_argument("--agg-port", type=int, default=None)
+    p.add_argument("--shuffle-ports", default=None,
+                   help="comma-separated record-plane ports, one per worker")
     return p
 
 
@@ -76,14 +81,15 @@ def _model_and_schema():
     return mdef, schema, cfg
 
 
-def _worker_records(worker, n, cfg):
-    """Worker ``worker``'s stream partition, deterministic per worker —
+def _stream_records(n, cfg):
+    """The ONE logical stream, generated identically on every process —
+    the parallelism-W source partitions it (subtask w emits w::W), and
     replay after a cohort restart regenerates identical records."""
     import numpy as np
 
     from flink_tensorflow_tpu.tensors import TensorValue
 
-    rng = np.random.RandomState(1000 + worker)
+    rng = np.random.RandomState(1000)
     records = []
     for i in range(n):
         x_wide = rng.rand(cfg["num_wide"]).astype(np.float32)
@@ -92,7 +98,7 @@ def _worker_records(worker, n, cfg):
             "dense": rng.rand(cfg["num_dense"]).astype(np.float32),
             "cat": rng.randint(0, cfg["hash_buckets"], (cfg["num_cat_slots"],)).astype(np.int32),
             "label": np.int32(x_wide[0] > 0.5),
-        }, meta={"id": i, "worker": worker}))
+        }, meta={"id": i}))
     return records
 
 
@@ -100,14 +106,52 @@ def _worker_records(worker, n, cfg):
 # worker mode
 # ---------------------------------------------------------------------------
 
+class _LossProbe:
+    """Per-process map stage behind the gang op: records this process's
+    loss sequence (for the cohort-agreement check), tags each record
+    with its gang subtask + step for the downstream aggregator, and
+    injects the TaskManager-loss failure mid-round."""
+
+    def __init__(self, args):
+        self.args = args
+        self.losses = []
+        self.subtask = 0
+
+    def make(self):
+        from flink_tensorflow_tpu.core import functions as fn
+
+        probe = self
+
+        class Probe(fn.MapFunction):
+            def clone(self):
+                return self  # one subtask per process: keep the handle
+
+            def open(self, ctx):
+                probe.subtask = ctx.subtask_index
+
+            def map(self, record):
+                probe.losses.append(float(record["loss"]))
+                step = len(probe.losses)
+                a = probe.args
+                if (not a.no_failure and a.attempt == 0
+                        and probe.subtask == a.fail_worker
+                        and step >= a.fail_at_step):
+                    # Injected TaskManager loss: die mid-round, off a
+                    # checkpoint boundary, taking the cohort's
+                    # collectives AND its shuffle channels down with us.
+                    os._exit(1)
+                return record.with_meta(gang_subtask=probe.subtask, step=step)
+
+        return Probe()
+
+
 def run_worker(args) -> int:
     from flink_tensorflow_tpu.utils.platform import force_cpu
 
     force_cpu(args.devices_per_worker)
-    import jax
     import optax
 
-    from flink_tensorflow_tpu import StreamExecutionEnvironment
+    from flink_tensorflow_tpu import DistributedConfig, StreamExecutionEnvironment
     from flink_tensorflow_tpu.functions import DPTrainWindowFunction
     from flink_tensorflow_tpu.parallel import latest_common_checkpoint, multihost
 
@@ -119,42 +163,50 @@ def run_worker(args) -> int:
     mesh = multihost.global_mesh({"data": topo.global_devices})
 
     mdef, schema, cfg = _model_and_schema()
-    local_batch = args.global_batch // args.workers
-    records = _worker_records(args.worker, args.records_per_worker, cfg)
+    W = args.workers
+    local_batch = args.global_batch // W
+    records = _stream_records(W * args.records_per_worker, cfg)
     total_steps = args.records_per_worker // local_batch
 
-    ckpt_root = os.path.join(args.work_dir, "ckpt")
-    my_ckpt = os.path.join(ckpt_root, f"w{args.worker}")
-    worker_dirs = [os.path.join(ckpt_root, f"w{w}") for w in range(args.workers)]
+    shared_ckpt = os.path.join(args.work_dir, "ckpt")
+    shuffle_ports = [int(x) for x in args.shuffle_ports.split(",")]
+    dist = DistributedConfig(
+        args.worker, W, tuple(f"127.0.0.1:{p}" for p in shuffle_ports),
+    )
+    # The framework namespaces per-process shards under the shared dir;
+    # ask the config for the paths instead of duplicating the format.
+    worker_dirs = [dist.process_checkpoint_dir(shared_ckpt, w) for w in range(W)]
 
     env = StreamExecutionEnvironment(parallelism=1)
     env.set_mesh(mesh)
+    env.set_distributed(dist)
     # Aligned-across-hosts barriers: checkpoint k lands after every
-    # worker's k * (ckpt_every_steps * local_batch)-th source record.
+    # source subtask's k * (ckpt_every_steps * local_batch)-th record,
+    # and the barriers cross processes through the record plane.
     env.enable_checkpointing(
-        my_ckpt, every_n_records=args.ckpt_every_steps * local_batch
+        shared_ckpt, every_n_records=args.ckpt_every_steps * local_batch
     )
 
-    losses = []
+    probe = _LossProbe(args)
+    received = []
 
-    def sink(record):
-        losses.append(float(record["loss"]))
-        if (not args.no_failure and args.attempt == 0
-                and args.worker == args.fail_worker
-                and len(losses) >= args.fail_at_step):
-            # Injected TaskManager loss: die mid-round, off a checkpoint
-            # boundary, taking the cohort's collectives down with us.
-            os._exit(1)
+    def agg_sink(record):
+        received.append((int(record.meta["gang_subtask"]),
+                         int(record.meta["step"]), float(record["loss"])))
 
     (
-        env.from_collection(records, parallelism=1)
+        env.from_collection(records, parallelism=W)
         .count_window(local_batch)
         .apply(
             DPTrainWindowFunction(mdef, optax.adam(1e-2), train_schema=schema,
                                   global_batch=args.global_batch),
-            name="dp_train",
+            name="dp_train", parallelism=W,
         )
-        .sink_to_callable(sink)
+        .map(probe.make(), name="loss_probe", parallelism=W)
+        # W -> 1 rebalance: worker 1's losses cross to process 0 over
+        # the record plane (the old RemoteSink/RemoteSource fan-in,
+        # now just an edge in the job graph).
+        .sink_to_callable(agg_sink, name="loss_agg", parallelism=1)
     )
 
     restored_id = None
@@ -163,7 +215,7 @@ def run_worker(args) -> int:
     env.execute(
         "multihost-dp-train",
         timeout=600,
-        restore_from=my_ckpt if restored_id is not None else None,
+        restore_from=shared_ckpt if restored_id is not None else None,
         restore_checkpoint_id=restored_id,
     )
 
@@ -173,66 +225,29 @@ def run_worker(args) -> int:
         "global_devices": topo.global_devices,
         "num_processes": topo.num_processes,
         "restored_checkpoint": restored_id,
-        "steps_this_attempt": len(losses),
+        "steps_this_attempt": len(probe.losses),
         "total_steps": total_steps,
-        "losses": [round(l, 6) for l in losses],
+        "losses": [round(l, 6) for l in probe.losses],
     }
     with open(os.path.join(args.work_dir, f"result_w{args.worker}.json"), "w") as f:
         json.dump(result, f)
 
-    # -- remote record plane: ship the loss stream to worker 0 ------------
-    _aggregate_phase(args, losses)
-    return 0
-
-
-def _aggregate_phase(args, losses) -> None:
-    """Every worker RemoteSinks its per-step losses; worker 0 fans them
-    in (multi-connection RemoteSource) and writes the cohort summary."""
-    import threading
-
-    import numpy as np
-
-    from flink_tensorflow_tpu import StreamExecutionEnvironment
-    from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
-    from flink_tensorflow_tpu.tensors import TensorValue
-
-    def ship():
-        senv = StreamExecutionEnvironment(parallelism=1)
-        data = [
-            TensorValue({"loss": np.float32(l)},
-                        meta={"worker": args.worker, "step": i})
-            for i, l in enumerate(losses)
-        ]
-        senv.from_collection(data, parallelism=1).add_sink(
-            RemoteSink("127.0.0.1", args.agg_port), name="ship_losses"
-        )
-        senv.execute("ship-losses", timeout=120)
-
     if args.worker == 0:
-        source = RemoteSource("127.0.0.1", args.agg_port, fan_in=args.workers)
-        aenv = StreamExecutionEnvironment(parallelism=1)
-        received = aenv.from_source(source, name="loss_fanin", parallelism=1).sink_to_list()
-        # Worker 0 ships to itself too — run the sink job on a thread.
-        t = threading.Thread(target=ship, daemon=True)
-        t.start()
-        aenv.execute("aggregate-losses", timeout=120)
-        t.join(timeout=30)
+        import numpy as np
+
         by_worker = {}
-        for r in received:
-            by_worker.setdefault(int(r.meta["worker"]), []).append(
-                (int(r.meta["step"]), float(r["loss"]))
-            )
+        for subtask, step, loss in received:
+            by_worker.setdefault(subtask, []).append((step, loss))
         summary = {
             "workers_reporting": sorted(by_worker),
             "records_received": len(received),
             "mean_final_loss": round(
                 float(np.mean([sorted(v)[-1][1] for v in by_worker.values()])), 6
-            ),
+            ) if by_worker else None,
         }
         with open(os.path.join(args.work_dir, "aggregate.json"), "w") as f:
             json.dump(summary, f)
-    else:
-        ship()
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -262,22 +277,30 @@ def run_parent(args) -> dict:
     from flink_tensorflow_tpu.parallel import CohortSupervisor
 
     work_dir = args.work_dir or tempfile.mkdtemp(prefix="multihost_dp_")
-    # Fresh ports per attempt: the dead coordinator's socket may linger.
+    # Fresh ports per attempt (1 coordinator + W shuffle endpoints): a
+    # dead attempt's sockets may linger in TIME_WAIT.
+    per_attempt = 1 + args.workers
     if args.base_port:
-        ports = {a: (args.base_port + a, args.base_port + 500 + a) for a in range(4)}
+        ports = {
+            a: tuple(args.base_port + a * per_attempt + i for i in range(per_attempt))
+            for a in range(4)
+        }
     else:
-        flat = _free_ports(8)
-        ports = {a: (flat[2 * a], flat[2 * a + 1]) for a in range(4)}
+        flat = _free_ports(4 * per_attempt)
+        ports = {
+            a: tuple(flat[a * per_attempt: (a + 1) * per_attempt])
+            for a in range(4)
+        }
 
     def command(worker, num_workers, attempt):
-        cport, aport = ports[attempt]
+        cport, *shuffle = ports[attempt]
         cmd = [
             sys.executable, os.path.abspath(__file__),
             "--worker", str(worker),
             "--workers", str(num_workers),
             "--attempt", str(attempt),
             "--coordinator-port", str(cport),
-            "--agg-port", str(aport),
+            "--shuffle-ports", ",".join(map(str, shuffle)),
             "--devices-per-worker", str(args.devices_per_worker),
             "--records-per-worker", str(args.records_per_worker),
             "--global-batch", str(args.global_batch),

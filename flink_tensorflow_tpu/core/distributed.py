@@ -126,6 +126,16 @@ class DistributedExecutor(LocalExecutor):
     def __init__(self, graph: DataflowGraph, *,
                  distributed: DistributedConfig, **kwargs):
         self.dist = distributed.validate()
+        # Pure-kwargs validation BEFORE binding the shuffle port — a
+        # raise after the bind would leak the cohort's listener socket.
+        if kwargs.get("checkpoint_every_n") is None and (
+                kwargs.get("checkpoint_dir") is not None):
+            raise ValueError(
+                "distributed checkpointing requires count-based triggers "
+                "(checkpoint.every_n_records): barrier ids must be a "
+                "deterministic function of the stream so every process "
+                "cuts the same snapshot"
+            )
         _, my_port = self.dist.endpoint(self.dist.process_index)
         self._server = ShuffleServer(
             self.dist.bind, my_port, on_error=self._transport_error,
@@ -139,14 +149,6 @@ class DistributedExecutor(LocalExecutor):
         #: Control channels to peers (lazy; used only by the single
         #: persist worker thread).
         self._control_writers: typing.Dict[int, RemoteChannelWriter] = {}
-        if kwargs.get("checkpoint_every_n") is None and (
-                kwargs.get("checkpoint_dir") is not None):
-            raise ValueError(
-                "distributed checkpointing requires count-based triggers "
-                "(checkpoint.every_n_records): barrier ids must be a "
-                "deterministic function of the stream so every process "
-                "cuts the same snapshot"
-            )
         try:
             super().__init__(graph, **kwargs)
         except BaseException:
@@ -169,6 +171,9 @@ class DistributedExecutor(LocalExecutor):
     # -- placement ------------------------------------------------------
     def _owns_subtask(self, t: Transformation, index: int) -> bool:
         return process_of_subtask(index, self.dist.num_processes) == self.dist.process_index
+
+    def _process_identity(self) -> typing.Tuple[int, int]:
+        return self.dist.process_index, self.dist.num_processes
 
     def _remote_writer(self, t: Transformation, subtask_index: int, channel_idx: int):
         peer = process_of_subtask(subtask_index, self.dist.num_processes)

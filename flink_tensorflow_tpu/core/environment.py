@@ -282,6 +282,19 @@ class StreamExecutionEnvironment:
 
         if self.checkpoint_dir is None:
             raise ValueError("restart_strategy requires enable_checkpointing(dir)")
+        if self.config.distributed is not None:
+            # Each process would restore its OWN shard's latest id with
+            # no cohort agreement: one process ahead of another diverges
+            # the stream positions permanently (sources replay from the
+            # ahead process's offsets; the behind process's keyed state
+            # misses those records forever).
+            raise ValueError(
+                "restart_strategy is per-process and cannot agree on a "
+                "cohort-wide restore point — supervise distributed jobs "
+                "with parallel.CohortSupervisor and restore from "
+                "parallel.latest_common_checkpoint(...) (see "
+                "examples/multihost_dp_train.py)"
+            )
         deadline = None if timeout is None else time.monotonic() + timeout
         attempt = 0
         restore = restore_from

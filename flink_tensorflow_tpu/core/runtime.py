@@ -363,6 +363,7 @@ class LocalExecutor:
                 device = (
                     self.device_provider(t.name, st.index) if self.device_provider else None
                 )
+                proc_idx, num_procs = self._process_identity()
                 ctx = RuntimeContext(
                     task_name=t.name,
                     subtask_index=st.index,
@@ -372,6 +373,8 @@ class LocalExecutor:
                     device=device,
                     mesh=self.mesh,
                     job_config=self.job_config,
+                    process_index=proc_idx,
+                    num_processes=num_procs,
                 )
                 st.operator.setup(ctx, st.output, state)
                 self.subtasks.append(st)
@@ -380,6 +383,10 @@ class LocalExecutor:
     def _owns_subtask(self, t: Transformation, index: int) -> bool:
         """Whether subtask ``index`` of ``t`` runs in this process."""
         return True
+
+    def _process_identity(self) -> typing.Tuple[int, int]:
+        """(process_index, num_processes) of this executor's cohort."""
+        return 0, 1
 
     def _remote_writer(self, t: Transformation, subtask_index: int, channel_idx: int):
         raise RuntimeError(

@@ -30,6 +30,8 @@ class RuntimeContext:
         device: typing.Optional["jax.Device"] = None,
         mesh: typing.Optional[typing.Any] = None,
         job_config: typing.Optional[dict] = None,
+        process_index: int = 0,
+        num_processes: int = 1,
     ):
         self.task_name = task_name
         self.subtask_index = subtask_index
@@ -41,6 +43,11 @@ class RuntimeContext:
         #: Shared jax.sharding.Mesh for gang operators (DP/TP training).
         self.mesh = mesh
         self.job_config = dict(job_config or {})
+        #: Cohort identity (DistributedExecutor): which process hosts
+        #: this subtask, out of how many.  Gang operators use it to
+        #: validate one-subtask-per-process placement.
+        self.process_index = process_index
+        self.num_processes = num_processes
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)

@@ -425,8 +425,21 @@ class DPTrainWindowFunction(fn.WindowFunction):
             raise RuntimeError(
                 "DPTrainWindowFunction needs env.set_mesh(...) — the gang owns the mesh"
             )
-        if ctx.parallelism != 1:
-            raise RuntimeError("gang operator must run with parallelism=1")
+        # Valid gang placements: parallelism 1 on a single-process
+        # executor (the gang owns the whole mesh; the manual multi-host
+        # pattern runs one such executor PER process), or — on a
+        # distributed-record-plane cohort — exactly one subtask per
+        # process (round-robin placement puts subtask p on process p, so
+        # every process participates in the collective step).  Anything
+        # else would leave some process outside the pjit call and the
+        # first collective would hang, not error.
+        required = ctx.num_processes if ctx.num_processes > 1 else 1
+        if ctx.parallelism != required:
+            raise RuntimeError(
+                f"gang operator parallelism must be {required} "
+                f"(num_processes={ctx.num_processes}) so every process "
+                f"joins the collective step; got {ctx.parallelism}"
+            )
         from flink_tensorflow_tpu.parallel.mesh import spans_processes
 
         self.ctx = ctx

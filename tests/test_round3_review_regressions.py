@@ -1,0 +1,127 @@
+"""Regression pins for the round-3 review findings (VERDICT r2 #8's
+successor file): each test reproduces a defect the review sweeps found
+in the round-3 work and locks in the fix."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.channels import InputGate
+from flink_tensorflow_tpu.core.shuffle import RemoteChannelWriter, ShuffleServer
+from flink_tensorflow_tpu.functions import ModelMapFunction
+from flink_tensorflow_tpu.models import get_model_def
+from flink_tensorflow_tpu.tensors import TensorValue
+
+
+@pytest.fixture(scope="module")
+def lenet_model():
+    mdef = get_model_def("lenet")
+    return mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+
+
+class TestWatermarkDoesNotOvertakeAsyncMap:
+    def test_event_time_window_after_async_map_drops_nothing(self, lenet_model):
+        """Review r3 finding: MapOperator broadcast watermarks while
+        records sat in the async micro-batch buffer — a downstream
+        event-time window then dropped them as late.  The operator now
+        flushes in-flight results before forwarding a watermark."""
+        rng = np.random.RandomState(0)
+        records = [
+            TensorValue({"image": rng.rand(28, 28, 1).astype(np.float32)},
+                        {"i": i, "ts": float(i)})
+            for i in range(12)
+        ]
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r.meta["ts"], watermark_every=1)
+            # micro_batch larger than the stream: without the
+            # flush-before-watermark rule EVERY record would still be
+            # buffered when the watermarks pass.
+            .map(ModelMapFunction(lenet_model, micro_batch=64))
+            .time_window_all(4.0)
+            .apply(_CountWindows(), name="etw")
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        total = sum(r["n"] for r in results)
+        assert total == 12, f"event-time windows dropped {12 - total} records"
+
+
+class _CountWindows(fn.WindowFunction):
+    def process_window(self, key, window, elements, out):
+        out.collect(TensorValue({"n": np.int64(len(elements))}))
+
+
+class TestRemoteWriterReconnects:
+    def test_write_recovers_after_peer_restart(self):
+        """Review r3 finding: a transient send failure left the dead
+        socket cached, wedging every later write (and with it every
+        commit gate).  The writer now drops the socket and reconnects."""
+        gate = InputGate(1)
+        server = ShuffleServer("127.0.0.1")
+        server.register_gate("op", 0, gate)
+        server.start()
+        port = server.port
+        w = RemoteChannelWriter("127.0.0.1", port, "op", 0, 0,
+                                connect_timeout_s=10.0)
+        w.write(el.StreamRecord(1))
+        assert gate.poll(timeout=10.0)[1].value == 1
+        server.close()
+        # The peer is gone: writes fail (possibly after one buffered
+        # send that TCP accepts before noticing the close).
+        with pytest.raises((OSError, TimeoutError)):
+            for _ in range(50):
+                w.write(el.StreamRecord(2))
+                time.sleep(0.01)
+        # Peer comes back on the same port: the writer must reconnect
+        # instead of failing forever on the cached dead socket.
+        gate2 = InputGate(1)
+        server2 = ShuffleServer("127.0.0.1", port)
+        server2.register_gate("op", 0, gate2)
+        server2.start()
+        try:
+            w.write(el.StreamRecord(3))
+            item = gate2.poll(timeout=10.0)
+            assert item is not None and item[1].value == 3
+        finally:
+            w.close()
+            server2.close()
+
+
+class TestDurableAckReaping:
+    def test_acks_at_or_below_gated_id_are_swept(self):
+        """Review r3 finding: timed-out gates leaked their ack sets.
+        Exercise the sweep directly on the executor's bookkeeping."""
+        from flink_tensorflow_tpu import DistributedConfig
+        from flink_tensorflow_tpu.core.distributed import DistributedExecutor
+        from flink_tensorflow_tpu.core.graph import DataflowGraph
+        from flink_tensorflow_tpu.core.operators import SourceOperator
+        from flink_tensorflow_tpu.io.sources import CollectionSource
+
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        g = DataflowGraph()
+        g.add("src", lambda: SourceOperator("src", CollectionSource([1])), 1,
+              is_source=True)
+        ex = DistributedExecutor(
+            g, distributed=DistributedConfig(0, 1, (f"127.0.0.1:{port}",)))
+        try:
+            # Straggler acks from a "peer" below and above the gated id.
+            ex._on_control(0, ("ckpt_durable", 1, 0))
+            ex._on_control(0, ("ckpt_durable", 5, 0))
+            assert ex._global_commit_gate(3)  # 1-process cohort: trivially durable
+            assert 1 not in ex._durable_acks and 3 not in ex._durable_acks
+            assert 5 in ex._durable_acks  # future ids survive the sweep
+        finally:
+            ex.cancel()
