@@ -136,10 +136,18 @@ class DistributedExecutor(LocalExecutor):
                 "deterministic function of the stream so every process "
                 "cuts the same snapshot"
             )
+        # One registry for server ingress counters AND the executor
+        # (resolve it here — super().__init__ would otherwise create its
+        # own when none was passed, splitting the accounting).
+        if kwargs.get("metric_registry") is None:
+            from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+            kwargs["metric_registry"] = MetricRegistry()
         _, my_port = self.dist.endpoint(self.dist.process_index)
         self._server = ShuffleServer(
             self.dist.bind, my_port, on_error=self._transport_error,
             on_control=self._on_control,
+            metrics=kwargs["metric_registry"],
         )
         self._remote_writers: typing.List[RemoteChannelWriter] = []
         #: Global 2PC commit point: checkpoint id -> processes that have
@@ -181,6 +189,7 @@ class DistributedExecutor(LocalExecutor):
         writer = RemoteChannelWriter(
             host, port, t.name, subtask_index, channel_idx,
             connect_timeout_s=self.dist.connect_timeout_s,
+            metrics=self.metrics,
         )
         self._remote_writers.append(writer)
         return writer

@@ -25,10 +25,12 @@ Gradients never touch this plane: they ride XLA collectives over
 ICI/DCN inside compiled steps (SURVEY.md §2).  This plane is the
 reference's *record* shuffle only.
 
-Framing: 4-byte little-endian length + pickle (protocol 5 — numpy
-record payloads serialize as buffer views, not byte copies).  The wire
-is trusted (cluster-internal, same codebase both ends), matching the
-reference's Java-serialization posture inside a Flink cluster.
+Framing: ``[u32 pickle_len][u16 nbuf][pickle][per buffer: u64 len +
+raw bytes]`` — pickle protocol 5 with OUT-OF-BAND buffers, so a
+record's numpy payload travels as raw buffer views (scatter-gather
+sendall), never copied into the pickle stream.  The wire is trusted
+(cluster-internal, same codebase both ends), matching the reference's
+Java-serialization posture inside a Flink cluster.
 """
 
 from __future__ import annotations
@@ -48,8 +50,10 @@ if typing.TYPE_CHECKING:
 
 logger = logging.getLogger(__name__)
 
-_LEN = struct.Struct("<I")
+_FRAME_HDR = struct.Struct("<IH")  # pickle byte length, out-of-band buffer count
+_BUF_HDR = struct.Struct("<Q")
 _MAX_FRAME = 1 << 30
+_SMALL_FRAME = 1 << 16
 
 
 def _recv_exact(conn: socket.socket, n: int) -> typing.Optional[bytes]:
@@ -67,29 +71,83 @@ def _recv_exact(conn: socket.socket, n: int) -> typing.Optional[bytes]:
     return b"".join(chunks)
 
 
-def _send_frame(conn: socket.socket, payload: bytes) -> None:
-    header = _LEN.pack(len(payload))
-    if len(payload) < (1 << 16):
-        conn.sendall(header + payload)  # one syscall for small frames
+def _recv_buffer(conn: socket.socket, n: int) -> bytearray:
+    """Read exactly n bytes into a MUTABLE buffer (for out-of-band
+    pickle buffers: numpy arrays reconstructed over read-only bytes
+    would come back writeable=False, silently breaking in-place user
+    code only in distributed runs)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(view[got:], min(1 << 20, n - got))
+        if r == 0:
+            raise ConnectionError("peer closed mid out-of-band buffer")
+        got += r
+    return buf
+
+
+def _send_obj(conn: socket.socket, obj: typing.Any) -> int:
+    """Serialize + send one frame; returns payload bytes on the wire.
+
+    Pickle protocol 5 with out-of-band buffers: a record's numpy payload
+    is sent as raw buffer views (scatter-gather), NOT copied into the
+    pickle stream — the send side of the "zero-copy record plane".
+    Non-contiguous leaves (rare) fall back to in-band pickling.
+    Layout: [u32 pickle_len][u16 nbuf][pickle][per buf: u64 len][bytes].
+    """
+    bufs: typing.List[pickle.PickleBuffer] = []
+    try:
+        data = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+        raws = [b.raw() for b in bufs]
+    except BufferError:
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        raws = []
+    parts: typing.List[typing.Any] = [_FRAME_HDR.pack(len(data), len(raws)), data]
+    total = len(data)
+    for raw in raws:
+        parts.append(_BUF_HDR.pack(raw.nbytes))
+        parts.append(raw)
+        total += raw.nbytes
+    if total < _SMALL_FRAME:
+        conn.sendall(b"".join(parts))  # join accepts memoryview parts
     else:
-        # Large record frames: don't copy megabytes just to prepend a
-        # 4-byte header (the writer is single-threaded per connection,
-        # so two sendalls cannot interleave).
-        conn.sendall(header)
-        conn.sendall(payload)
+        # Large frames: one sendall per part — no megabyte concatenation
+        # (the writer is single-threaded per connection, so the parts
+        # cannot interleave).
+        for p in parts:
+            conn.sendall(p)
+    return total
 
 
-def _recv_frame(conn: socket.socket) -> typing.Optional[bytes]:
-    head = _recv_exact(conn, _LEN.size)
+#: Sentinel for clean EOF at a frame boundary (a frame could pickle None).
+_EOF = object()
+
+
+def _recv_obj(conn: socket.socket) -> typing.Tuple[typing.Any, int]:
+    """Receive one frame; returns (object, payload_bytes) or (_EOF, 0)
+    on clean EOF at a frame boundary."""
+    head = _recv_exact(conn, _FRAME_HDR.size)
     if head is None:
-        return None
-    (length,) = _LEN.unpack(head)
-    if length > _MAX_FRAME:
-        raise ConnectionError(f"oversized frame ({length} bytes)")
-    payload = _recv_exact(conn, length)
-    if payload is None:
+        return _EOF, 0
+    plen, nbuf = _FRAME_HDR.unpack(head)
+    if plen > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({plen} bytes)")
+    data = _recv_exact(conn, plen)
+    if data is None:
         raise ConnectionError("peer closed between header and body")
-    return payload
+    total = plen
+    buffers: typing.List[bytearray] = []
+    for _ in range(nbuf):
+        bh = _recv_exact(conn, _BUF_HDR.size)
+        if bh is None:
+            raise ConnectionError("peer closed before out-of-band buffer")
+        (blen,) = _BUF_HDR.unpack(bh)
+        if blen > _MAX_FRAME:
+            raise ConnectionError(f"oversized buffer ({blen} bytes)")
+        buffers.append(_recv_buffer(conn, blen))
+        total += blen
+    return pickle.loads(data, buffers=buffers), total
 
 
 class ShuffleServer:
@@ -111,7 +169,8 @@ class ShuffleServer:
 
     def __init__(self, bind: str = "0.0.0.0", port: int = 0, *,
                  on_error: typing.Optional[typing.Callable[[BaseException], None]] = None,
-                 on_control: typing.Optional[typing.Callable[[int, typing.Any], None]] = None):
+                 on_control: typing.Optional[typing.Callable[[int, typing.Any], None]] = None,
+                 metrics: typing.Optional[typing.Any] = None):
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind, port))
@@ -119,6 +178,10 @@ class ShuffleServer:
         self.port: int = self._listener.getsockname()[1]
         self.on_error = on_error
         self.on_control = on_control
+        #: MetricRegistry for ingress traffic accounting (Flink's network
+        #: metrics analogue); counters are scoped per CHANNEL so each
+        #: reader thread owns its own (Counter.inc is not atomic).
+        self.metrics = metrics
         self._gates: typing.Dict[typing.Tuple[str, int], "InputGate"] = {}
         self._threads: typing.List[threading.Thread] = []
         self._conns: typing.List[socket.socket] = []
@@ -158,33 +221,43 @@ class ShuffleServer:
     def _reader(self, conn: socket.socket) -> None:
         route = "<handshake>"
         try:
-            hello = _recv_frame(conn)
-            if hello is None:
+            hello, _ = _recv_obj(conn)
+            if hello is _EOF:
                 return  # peer probed and left before the handshake
-            task, subtask_index, channel_idx = pickle.loads(hello)
+            task, subtask_index, channel_idx = hello
             route = f"{task}.{subtask_index}[ch{channel_idx}]"
             if task == self.CONTROL_TASK:
                 # Coordinator control plane: subtask_index is the SENDER
                 # process; frames are opaque control messages.  EOF is a
                 # clean close (no EndOfPartition on control routes).
                 while True:
-                    payload = _recv_frame(conn)
-                    if payload is None:
+                    message, _ = _recv_obj(conn)
+                    if message is _EOF:
                         return
                     if self.on_control is not None:
-                        self.on_control(subtask_index, pickle.loads(payload))
+                        self.on_control(subtask_index, message)
             gate = self._gates.get((task, subtask_index))
             if gate is None:
                 raise ConnectionError(
                     f"no local gate for route {route} — placement mismatch "
                     "(peers must build the identical job graph)"
                 )
+            records = bytes_in = None
+            if self.metrics is not None:
+                # Scope includes the channel: one reader thread per
+                # connection = one writer per counter (Counter.inc is a
+                # plain += and must stay single-writer).
+                group = self.metrics.group(
+                    f"shuffle.in.{task}.{subtask_index}.ch{channel_idx}")
+                records, bytes_in = group.counter("records"), group.counter("bytes")
             saw_eop = False
             while True:
-                payload = _recv_frame(conn)
-                if payload is None:
+                element, nbytes = _recv_obj(conn)
+                if element is _EOF:
                     break
-                element = pickle.loads(payload)
+                if records is not None and isinstance(element, el.StreamRecord):
+                    records.inc()
+                    bytes_in.inc(nbytes)
                 saw_eop = isinstance(element, el.EndOfPartition)
                 gate.put(channel_idx, element)
             if not saw_eop and not self._stop.is_set():
@@ -238,7 +311,8 @@ class RemoteChannelWriter:
     """
 
     def __init__(self, host: str, port: int, task: str, subtask_index: int,
-                 channel_idx: int, *, connect_timeout_s: float = 60.0):
+                 channel_idx: int, *, connect_timeout_s: float = 60.0,
+                 metrics: typing.Optional[typing.Any] = None):
         self.host = host
         self.port = port
         self.task = task
@@ -247,6 +321,14 @@ class RemoteChannelWriter:
         self.connect_timeout_s = connect_timeout_s
         self._sock: typing.Optional[socket.socket] = None
         self._closed = False
+        self._records = self._bytes = None
+        if metrics is not None:
+            # Per-channel scope: each writer (one upstream subtask
+            # thread) owns its counters — Counter.inc is not atomic.
+            group = metrics.group(
+                f"shuffle.out.{task}.{subtask_index}.ch{channel_idx}")
+            self._records = group.counter("records")
+            self._bytes = group.counter("bytes")
 
     def _connect(self) -> None:
         deadline = time.monotonic() + self.connect_timeout_s
@@ -266,10 +348,7 @@ class RemoteChannelWriter:
                 time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_frame(self._sock, pickle.dumps(
-            (self.task, self.subtask_index, self.channel_idx),
-            protocol=pickle.HIGHEST_PROTOCOL,
-        ))
+        _send_obj(self._sock, (self.task, self.subtask_index, self.channel_idx))
 
     def write(self, element: el.StreamElement) -> None:
         if self._closed:
@@ -277,8 +356,10 @@ class RemoteChannelWriter:
         if self._sock is None:
             self._connect()
         try:
-            _send_frame(self._sock, pickle.dumps(
-                element, protocol=pickle.HIGHEST_PROTOCOL))
+            nbytes = _send_obj(self._sock, element)
+            if self._records is not None and isinstance(element, el.StreamRecord):
+                self._records.inc()
+                self._bytes.inc(nbytes)
         except OSError:
             # Drop the dead socket so a LATER write reconnects instead of
             # failing forever on the cached fd (control writers are

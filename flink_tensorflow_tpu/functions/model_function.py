@@ -505,14 +505,91 @@ class _GraphFunctionBase(fn.RichFunction):
         return batch.unbatch(DeviceTransfer.fetch(outputs))
 
 
-class GraphMapFunction(_GraphFunctionBase, fn.MapFunction):
+class GraphMapFunction(_GraphFunctionBase, fn.AsyncMapFunction):
+    """Per-record inference over a frozen artifact, pipelined.
+
+    Frozen graphs are shape-specialized at export (batch=1 here), so
+    there is no transparent micro-batching — but dispatches ride a small
+    thread pool with up to ``pipeline_depth`` in flight, so throughput
+    is bounded by ``pipeline_depth / RTT`` instead of one synchronous
+    round trip per record (the ModelMapFunction rework's guarantee,
+    applied to the GraphFunction idiom).  Results surface in arrival
+    order; lulls drain after ``idle_flush_s``; end-of-input and barriers
+    flush everything in flight.
+    """
+
     def __init__(self, graph, *, input_schema, needs_lengths: bool = False,
-                 length_bucket: int = 128):
+                 length_bucket: int = 128, pipeline_depth: int = 4,
+                 idle_flush_s: float = 0.01):
         super().__init__(graph, batch=1, input_schema=input_schema,
                          needs_lengths=needs_lengths, length_bucket=length_bucket)
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self._depth = pipeline_depth
+        self._idle_flush_s = idle_flush_s
+        self._pool = None
+        self._pending: typing.Optional[typing.Deque] = None
+        self._out: typing.Optional[fn.Collector] = None
+        self._last_activity: typing.Optional[float] = None
 
-    def map(self, value):
-        return self._run([value])[0]
+    def clone(self):
+        dup = super().clone()
+        dup._pool = None
+        dup._pending = None
+        dup._out = None
+        dup._last_activity = None
+        return dup
+
+    def open(self, ctx) -> None:
+        import collections
+        import concurrent.futures
+
+        super().open(ctx)
+        self._pending = collections.deque()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._depth, thread_name_prefix="graph-map")
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        self._pending = None
+        super().close()
+
+    def map_async(self, value, out: fn.Collector):
+        self._out = out
+        self._pending.append(self._pool.submit(lambda: self._run([value])[0]))
+        self._last_activity = time.monotonic()
+        # FIFO emission: drain completed heads, then block only to keep
+        # the in-flight count at the pipeline depth.
+        while self._pending and (
+                self._pending[0].done() or len(self._pending) > self._depth):
+            out.collect(self._pending.popleft().result())
+
+    def flush(self, out: typing.Optional[fn.Collector] = None):
+        out = out if out is not None else self._out
+        while self._pending:
+            result = self._pending.popleft().result()
+            if out is not None:
+                out.collect(result)
+
+    def next_deadline(self) -> typing.Optional[float]:
+        if not self._pending or self._last_activity is None:
+            return None
+        return self._last_activity + self._idle_flush_s
+
+    def fire_due(self, now: float) -> None:
+        if self._pending and self._out is not None:
+            while self._pending and self._pending[0].done():
+                self._out.collect(self._pending.popleft().result())
+            self._last_activity = now  # re-arm until the queue drains
+
+    def on_finish(self, out: fn.Collector):
+        self.flush(out)
+
+    def snapshot_state(self):
+        self.flush()
+        return None
 
 
 class GraphWindowFunction(_GraphFunctionBase, fn.WindowFunction):

@@ -161,6 +161,27 @@ class TestAsyncModelMap:
         assert mapped < 2.5 * windowed, (
             f"async map {mapped:.3f}s vs windowed {windowed:.3f}s")
 
+    def test_graph_map_pipelined(self, lenet_model, images, expected_labels):
+        """GraphMapFunction (frozen batch-1 artifact) is also async:
+        pipelined batch-of-1 dispatches, FIFO order, exact labels."""
+        from flink_tensorflow_tpu.functions import GraphMapFunction
+        from flink_tensorflow_tpu.models import freeze_method
+
+        frozen = freeze_method(lenet_model, "serve", batch=1)
+        env = StreamExecutionEnvironment(parallelism=1)
+        results = (
+            env.from_collection(images, parallelism=1)
+            .map(GraphMapFunction(
+                frozen,
+                input_schema=lenet_model.method("serve").input_schema,
+                pipeline_depth=3,
+            ))
+            .sink_to_list()
+        )
+        env.execute(timeout=180)
+        assert [r.meta["i"] for r in results] == list(range(10))
+        assert [int(r["label"]) for r in results] == expected_labels
+
     def test_snapshot_flushes_in_flight(self, lenet_model, images, expected_labels):
         """snapshot_state must emit buffered + in-flight results before
         the barrier: emulate the operator's snapshot sequence directly."""

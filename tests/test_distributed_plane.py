@@ -85,6 +85,39 @@ class TestShuffleTransport:
         finally:
             server.close()
 
+    def test_large_record_out_of_band_roundtrip(self):
+        """Multi-MB numpy payloads ride protocol-5 out-of-band buffers
+        (raw views on the wire, not copies into the pickle stream) and
+        reconstruct exactly."""
+        import numpy as np
+
+        from flink_tensorflow_tpu.tensors import TensorValue
+
+        gate = InputGate(1, capacity=4)
+        server = ShuffleServer("127.0.0.1")
+        server.register_gate("op", 0, gate)
+        server.start()
+        try:
+            w = RemoteChannelWriter("127.0.0.1", server.port, "op", 0, 0,
+                                    connect_timeout_s=10.0)
+            rng = np.random.RandomState(0)
+            img = rng.randint(0, 256, (299, 299, 3)).astype(np.uint8)
+            vec = rng.randn(1 << 20).astype(np.float32)  # 4MB
+            w.write(el.StreamRecord(
+                TensorValue({"image": img, "vec": vec}, {"i": 7}), 1.25))
+            idx, got = gate.poll(timeout=30.0)
+            assert got.timestamp == 1.25
+            assert got.value.meta["i"] == 7
+            np.testing.assert_array_equal(got.value["image"], img)
+            np.testing.assert_array_equal(got.value["vec"], vec)
+            # Non-contiguous leaves fall back to in-band pickling.
+            w.write(el.StreamRecord(TensorValue({"t": img[::2, ::2]}, {})))
+            _, got2 = gate.poll(timeout=30.0)
+            np.testing.assert_array_equal(got2.value["t"], img[::2, ::2])
+            w.close()
+        finally:
+            server.close()
+
     def test_disconnect_before_eop_reports_error(self):
         errors = []
         gate = InputGate(1)
@@ -119,6 +152,33 @@ class TestShuffleTransport:
             while not msgs and time.monotonic() < deadline:
                 time.sleep(0.02)
             assert msgs == [(1, ("ckpt_durable", 7, 1))]
+            w.close()
+        finally:
+            server.close()
+
+
+class TestShuffleMetrics:
+    def test_traffic_counters(self):
+        from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+        reg = MetricRegistry()
+        gate = InputGate(1)
+        server = ShuffleServer("127.0.0.1", metrics=reg)
+        server.register_gate("op", 0, gate)
+        server.start()
+        try:
+            w = RemoteChannelWriter("127.0.0.1", server.port, "op", 0, 0,
+                                    connect_timeout_s=10.0, metrics=reg)
+            for i in range(5):
+                w.write(el.StreamRecord(i))
+            w.write(el.EndOfPartition())
+            for _ in range(6):
+                assert gate.poll(timeout=10.0) is not None
+            report = reg.report()
+            # Control elements (EOP) are not records: 5 counted, not 6.
+            assert report["shuffle.out.op.0.ch0.records"] == 5
+            assert report["shuffle.in.op.0.ch0.records"] == 5
+            assert report["shuffle.out.op.0.ch0.bytes"] == report["shuffle.in.op.0.ch0.bytes"] > 0
             w.close()
         finally:
             server.close()
