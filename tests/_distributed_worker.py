@@ -51,6 +51,33 @@ def expected_emissions(n):
     return sorted(out)
 
 
+class WindowSum(fn.WindowFunction):
+    """Keyed count-window aggregate: emits (key, window_sum, count,
+    first_element) — ``first`` pins window boundaries in the test's
+    expected-output mirror."""
+
+    def process_window(self, key, window, elements, out):
+        vals = [int(v) for v in elements]
+        out.collect(TensorValue(
+            {"s": np.int64(sum(vals))},
+            {"key": int(key), "n": len(vals), "first": vals[0]},
+        ))
+
+
+def expected_windows(n, size):
+    """Per key, tumbling count windows of ``size`` in arrival order
+    (the last partial window flushes at end of input)."""
+    per_key = {k: [] for k in range(NUM_KEYS)}
+    for i in range(n):
+        per_key[i % NUM_KEYS].append(i)
+    out = []
+    for k, vals in per_key.items():
+        for j in range(0, len(vals), size):
+            chunk = vals[j:j + size]
+            out.append((k, sum(chunk), len(chunk), chunk[0]))
+    return sorted(out)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--index", type=int, required=True)
@@ -61,6 +88,9 @@ def main():
     p.add_argument("--every", type=int, default=20)
     p.add_argument("--restore-id", type=int, default=-1)
     p.add_argument("--throttle", type=float, default=0.0)
+    p.add_argument("--job", default="keyed_sum",
+                   choices=("keyed_sum", "keyed_window"))
+    p.add_argument("--window", type=int, default=5)
     args = p.parse_args()
 
     ports = [int(x) for x in args.ports.split(",")]
@@ -71,12 +101,23 @@ def main():
                                           connect_timeout_s=30.0))
     if args.chk:
         env.enable_checkpointing(args.chk, every_n_records=args.every)
-    (
+    keyed = (
         env.from_collection(list(range(args.n)), parallelism=1)
         .key_by(lambda x: x % NUM_KEYS)
-        .process(KeyedSum(), name="keyed_sum", parallelism=2)
-        .add_sink(ExactlyOnceRecordFileSink(args.out), name="sink", parallelism=1)
     )
+    if args.job == "keyed_sum":
+        stage = keyed.process(KeyedSum(), name="keyed_sum", parallelism=2)
+    else:
+        # Keyed count window spanning processes: the window operator's
+        # per-key buffers live on whichever process owns the key group.
+        # The latency budget is deliberately enormous — the test asserts
+        # exact tumbling windows, so no deadline fire may trigger even
+        # on a badly stalled CI host (deadline-driven fires are covered
+        # by tests/test_adaptive_batching.py); it still exercises the
+        # adaptive trigger's code path through the plane.
+        stage = keyed.count_window(args.window, latency_budget_s=600.0).apply(
+            WindowSum(), name="keyed_window", parallelism=2)
+    stage.add_sink(ExactlyOnceRecordFileSink(args.out), name="sink", parallelism=1)
     kw = {}
     if args.restore_id >= 0:
         kw = dict(restore_from=args.chk, restore_checkpoint_id=args.restore_id)

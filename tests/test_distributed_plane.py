@@ -42,6 +42,20 @@ def expected_emissions(n, num_keys=4):
     return sorted(out)
 
 
+def expected_windows(n, size, num_keys=4):
+    """Mirror of the worker's keyed tumbling count windows (kept in sync
+    with _distributed_worker.py)."""
+    per_key = {k: [] for k in range(num_keys)}
+    for i in range(n):
+        per_key[i % num_keys].append(i)
+    out = []
+    for k, vals in per_key.items():
+        for j in range(0, len(vals), size):
+            chunk = vals[j:j + size]
+            out.append((k, sum(chunk), len(chunk), chunk[0]))
+    return sorted(out)
+
+
 def _free_ports(n):
     socks, ports = [], []
     for _ in range(n):
@@ -217,12 +231,13 @@ class TestManualTriggerForbidden:
 
 
 def _spawn(index, ports, out, chk=None, n=80, every=20, restore_id=-1,
-           throttle=0.0):
+           throttle=0.0, job="keyed_sum", window=5):
     cmd = [
         sys.executable, _WORKER, "--index", str(index),
         "--ports", ",".join(map(str, ports)), "--out", out,
         "--n", str(n), "--every", str(every),
         "--restore-id", str(restore_id), "--throttle", str(throttle),
+        "--job", job, "--window", str(window),
     ]
     if chk:
         cmd += ["--chk", chk]
@@ -265,6 +280,29 @@ class TestTwoProcessJob:
         for rc, log in results:
             assert rc == 0, f"worker failed:\n{log}"
         assert _read_sorted(out) == expected_emissions(80)
+
+    def test_keyed_count_window_spans_processes(self, tmp_path):
+        """Keyed count windows with the adaptive trigger, key groups
+        split over two processes: every tumbling per-key window (and the
+        end-of-input partial) lands exactly once with the right sum."""
+        ports = _free_ports(2)
+        out = str(tmp_path / "out")
+        n, window = 78, 5  # 78/4 keys -> partial final windows
+        procs = [
+            _spawn(i, ports, out, n=n, job="keyed_window", window=window)
+            for i in range(2)
+        ]
+        results = [_wait(p) for p in procs]
+        for rc, log in results:
+            assert rc == 0, f"worker failed:\n{log}"
+        from flink_tensorflow_tpu.io.files import read_committed
+
+        got = sorted(
+            (int(r.meta["key"]), int(r["s"]), int(r.meta["n"]),
+             int(r.meta["first"]))
+            for r in read_committed(out)
+        )
+        assert got == expected_windows(n, window)
 
     def test_kill_and_restore_exactly_once(self, tmp_path):
         """Kill worker 1 mid-stream (after aligned checkpoints crossed
