@@ -175,7 +175,15 @@ def _compute_probe(model, probe_b: int, dev, *, smoke: bool = False) -> dict:
         return sorted(ts)[1]
 
     t1, t2 = timed(k1), timed(k2)
-    per_fwd_s = max((t2 - t1) / (k2 - k1), 1e-9)
+    per_fwd_s = (t2 - t1) / (k2 - k1)
+    if per_fwd_s <= 0 and not smoke:
+        # Tunnel RTT variance swamped the delta (observed: medians can
+        # invert under load) — widen the spread once before giving up.
+        k2 = k2 * 4
+        t2 = timed(k2)
+        per_fwd_s = (t2 - t1) / (k2 - k1)
+    probe_degenerate = per_fwd_s <= 0
+    per_fwd_s = max(per_fwd_s, 1e-9)
     records_per_s = probe_b / per_fwd_s
 
     flops_per_fwd = None
@@ -212,11 +220,23 @@ def _compute_probe(model, probe_b: int, dev, *, smoke: bool = False) -> dict:
     }
     # Hard physical-sanity bound: a compute-rate claim above chip peak
     # means the probe (not the chip) is broken — cap it and say so.
-    if peak_tflops is not None and achieved_tflops > peak_tflops:
-        capped = records_per_s * peak_tflops / achieved_tflops
-        out["records_per_sec"] = round(capped, 1)
-        out["achieved_tflops"] = peak_tflops
-        out["mfu_pct"] = 100.0
+    if probe_degenerate or (
+            peak_tflops is not None and achieved_tflops > peak_tflops):
+        if peak_tflops is not None:
+            # Report the peak-derived UPPER BOUND, flagged invalid — and
+            # keep every derived field consistent with it.
+            out["records_per_sec"] = round(
+                peak_tflops * 1e12 / (flops_per_fwd / probe_b), 1)
+            out["per_record_us"] = round(
+                1e6 * probe_b / out["records_per_sec"], 2)
+            out["achieved_tflops"] = peak_tflops
+            out["mfu_pct"] = 100.0
+        else:
+            # No peak to cap against: emit nothing rather than garbage.
+            out["records_per_sec"] = None
+            out["per_record_us"] = None
+            out["achieved_tflops"] = None
+            out["mfu_pct"] = None
         out["probe_invalid_capped_to_peak"] = True
     return out
 
@@ -397,7 +417,8 @@ def bench_inception(args) -> dict:
         # On-device forward rate from a resident fori-loop, with MFU.
         "device_compute": compute,
         "bottleneck": (
-            "host->device wire bandwidth of the tunnel-attached device"
+            "unknown (device-compute probe invalid)" if not compute_rps
+            else "host->device wire bandwidth of the tunnel-attached device"
             if wire_ceiling_rps < 0.7 * compute_rps
             else "device compute"
         ),

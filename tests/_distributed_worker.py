@@ -64,18 +64,6 @@ class WindowSum(fn.WindowFunction):
         ))
 
 
-def expected_windows(n, size):
-    """Per key, tumbling count windows of ``size`` in arrival order
-    (the last partial window flushes at end of input)."""
-    per_key = {k: [] for k in range(NUM_KEYS)}
-    for i in range(n):
-        per_key[i % NUM_KEYS].append(i)
-    out = []
-    for k, vals in per_key.items():
-        for j in range(0, len(vals), size):
-            chunk = vals[j:j + size]
-            out.append((k, sum(chunk), len(chunk), chunk[0]))
-    return sorted(out)
 
 
 def main():
