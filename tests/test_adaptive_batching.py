@@ -175,6 +175,44 @@ class TestWindowOperatorIntegration:
         lat = np.percentile(np.asarray(latencies), 50)
         assert lat < 1.0, f"p50 {lat:.3f}s should beat the 1.5s budget"
 
+    def test_adaptive_trigger_with_ring_ingestion(self):
+        """The adaptive trigger is non-retaining, so zero-copy ring
+        ingestion stays eligible; partial (early-fired) windows must
+        claim/pad arena slots correctly."""
+        import jax
+
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+        from flink_tensorflow_tpu.models import get_model_def
+        from flink_tensorflow_tpu.tensors import BucketPolicy, TensorValue
+
+        mdef = get_model_def("lenet")
+        model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+        rng = np.random.RandomState(5)
+        records = [
+            TensorValue({"image": rng.rand(28, 28, 1).astype(np.float32)},
+                        {"i": i})
+            for i in range(11)
+        ]
+        env = StreamExecutionEnvironment(parallelism=1)
+        f = ModelWindowFunction(model, policy=BucketPolicy(fixed_batch=4),
+                                warmup_batches=(4,))
+        results = (
+            env.from_source(
+                PacedSource(records, 40.0, jitter="none"), name="paced",
+                parallelism=1)
+            .count_window(4, latency_budget_s=0.15)
+            .apply(f, name="ringwin")
+            .sink_to_list()
+        )
+        env.execute(timeout=180)
+        serve = jax.jit(model.method("serve").fn)
+        import jax.numpy as jnp
+
+        ref = serve(model.params,
+                    {"image": jnp.stack([jnp.asarray(r["image"]) for r in records])})
+        got = {r.meta["i"]: int(r["label"]) for r in results}
+        assert got == {i: int(x) for i, x in enumerate(np.asarray(ref["label"]))}
+
     def test_full_rate_stream_keeps_full_windows(self):
         """from_collection (infinite rate): every steady window is full —
         the adaptive policy must not shrink batches when the rate

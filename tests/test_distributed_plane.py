@@ -304,10 +304,14 @@ class TestTwoProcessJob:
         )
         assert got == expected_windows(n, window)
 
-    def test_kill_and_restore_exactly_once(self, tmp_path):
-        """Kill worker 1 mid-stream (after aligned checkpoints crossed
+    @pytest.mark.parametrize("victim", [1, 0])
+    def test_kill_and_restore_exactly_once(self, tmp_path, victim):
+        """Kill one worker mid-stream (after aligned checkpoints crossed
         the wire), restore BOTH processes from the latest common
-        checkpoint: committed output is still exactly-once.
+        checkpoint: committed output is still exactly-once.  victim=0
+        kills the process hosting the source AND the 2PC sink (staged
+        transactions must be retracted/recommitted on restore);
+        victim=1 kills the peer keyed subtask.
 
         Both workers point at ONE shared checkpoint directory — the
         framework namespaces a per-process shard under it (proc-00000/
@@ -338,13 +342,14 @@ class TestTwoProcessJob:
             time.sleep(0.02)
         rcs = [p.poll() for p in procs]
         assert common is not None, f"no common checkpoint before exit (rcs={rcs})"
-        procs[1].send_signal(signal.SIGKILL)
-        rc0, log0 = _wait(procs[0])
-        rc1, _ = _wait(procs[1])
-        assert rc1 != 0
-        # Worker 0 must notice the peer loss and fail (not hang, not
+        survivor = 1 - victim
+        procs[victim].send_signal(signal.SIGKILL)
+        rc_s, log_s = _wait(procs[survivor])
+        rc_v, _ = _wait(procs[victim])
+        assert rc_v != 0
+        # The survivor must notice the peer loss and fail (not hang, not
         # report success on a truncated stream).
-        assert rc0 != 0, f"worker 0 ignored peer loss:\n{log0}"
+        assert rc_s != 0, f"worker {survivor} ignored peer loss:\n{log_s}"
 
         common = latest_common_checkpoint(chks)
         assert common is not None
