@@ -66,6 +66,48 @@ class WindowSum(fn.WindowFunction):
 
 
 
+def _keyed_train_stage(env, args):
+    """The reference's Wide&Deep workload shape (BASELINE.json:10 —
+    "keyed stream, per-key SGD step") spanning the cohort: user-keyed
+    feature records cross processes to whichever subtask owns the key
+    group; each key trains its own tiny model in keyed state."""
+    import optax
+
+    from flink_tensorflow_tpu.functions import OnlineTrainFunction
+    from flink_tensorflow_tpu.models import get_model_def
+    from flink_tensorflow_tpu.tensors import RecordSchema, spec
+
+    cfg = dict(hash_buckets=50, embed_dim=2, num_cat_slots=2,
+               num_dense=4, num_wide=4, hidden=(8,))
+    mdef = get_model_def("widedeep", **cfg)
+    schema = RecordSchema({
+        "wide": spec((cfg["num_wide"],)),
+        "dense": spec((cfg["num_dense"],)),
+        "cat": spec((cfg["num_cat_slots"],), np.int32),
+        "label": spec((), np.int32),
+    })
+    rng = np.random.RandomState(7)
+    records = []
+    for i in range(args.n):
+        x_wide = rng.rand(cfg["num_wide"]).astype(np.float32)
+        records.append(TensorValue({
+            "wide": x_wide,
+            "dense": rng.rand(cfg["num_dense"]).astype(np.float32),
+            "cat": rng.randint(0, cfg["hash_buckets"],
+                               (cfg["num_cat_slots"],)).astype(np.int32),
+            "label": np.int32(x_wide[0] > 0.5),
+        }, meta={"user": i % NUM_KEYS}))
+    return (
+        env.from_collection(records, parallelism=1)
+        .key_by(lambda r: r.meta["user"])
+        .process(
+            OnlineTrainFunction(mdef, optax.sgd(0.05), train_schema=schema,
+                                scope="key", mini_batch=2),
+            name="keyed_train", parallelism=2,
+        )
+    )
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--index", type=int, required=True)
@@ -77,7 +119,7 @@ def main():
     p.add_argument("--restore-id", type=int, default=-1)
     p.add_argument("--throttle", type=float, default=0.0)
     p.add_argument("--job", default="keyed_sum",
-                   choices=("keyed_sum", "keyed_window"))
+                   choices=("keyed_sum", "keyed_window", "keyed_train"))
     p.add_argument("--window", type=int, default=5)
     args = p.parse_args()
 
@@ -89,13 +131,19 @@ def main():
                                           connect_timeout_s=30.0))
     if args.chk:
         env.enable_checkpointing(args.chk, every_n_records=args.every)
-    keyed = (
-        env.from_collection(list(range(args.n)), parallelism=1)
-        .key_by(lambda x: x % NUM_KEYS)
-    )
-    if args.job == "keyed_sum":
-        stage = keyed.process(KeyedSum(), name="keyed_sum", parallelism=2)
+    if args.job == "keyed_train":
+        stage = _keyed_train_stage(env, args)
+    elif args.job == "keyed_sum":
+        stage = (
+            env.from_collection(list(range(args.n)), parallelism=1)
+            .key_by(lambda x: x % NUM_KEYS)
+            .process(KeyedSum(), name="keyed_sum", parallelism=2)
+        )
     else:
+        keyed = (
+            env.from_collection(list(range(args.n)), parallelism=1)
+            .key_by(lambda x: x % NUM_KEYS)
+        )
         # Keyed count window spanning processes: the window operator's
         # per-key buffers live on whichever process owns the key group.
         # The latency budget is deliberately enormous — the test asserts

@@ -304,6 +304,36 @@ class TestTwoProcessJob:
         )
         assert got == expected_windows(n, window)
 
+    def test_keyed_online_training_spans_processes(self, tmp_path):
+        """The reference's Wide&Deep shape (keyed stream, per-key SGD,
+        BASELINE.json:10) with key groups over two processes: each key
+        trains in keyed state wherever its group lives, metrics commit
+        through the 2PC sink — exactly one step record per mini-batch
+        per key, plus the end-of-input partial flush."""
+        from flink_tensorflow_tpu.io.files import read_committed
+
+        ports = _free_ports(2)
+        out = str(tmp_path / "out")
+        n, mini_batch, keys = 50, 2, 4
+        procs = [
+            _spawn(i, ports, out, n=n, job="keyed_train") for i in range(2)
+        ]
+        results = [_wait(p) for p in procs]
+        for rc, log in results:
+            assert rc == 0, f"worker failed:\n{log}"
+        committed = read_committed(out)
+        per_key = {}
+        for r in committed:
+            assert float(r["loss"]) == float(r["loss"])  # finite
+            per_key.setdefault(int(r.meta["key"]), []).append(int(r["step"]))
+        counts = {k: (n + keys - 1 - k) // keys for k in range(keys)}
+        expected_steps = {
+            k: (c + mini_batch - 1) // mini_batch for k, c in counts.items()
+        }
+        assert {k: len(v) for k, v in per_key.items()} == expected_steps
+        for k, steps in per_key.items():
+            assert sorted(steps) == list(range(1, expected_steps[k] + 1))
+
     @pytest.mark.parametrize("victim", [1, 0])
     def test_kill_and_restore_exactly_once(self, tmp_path, victim):
         """Kill one worker mid-stream (after aligned checkpoints crossed
