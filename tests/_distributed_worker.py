@@ -103,7 +103,7 @@ def _keyed_train_stage(env, args):
         .process(
             OnlineTrainFunction(mdef, optax.sgd(0.05), train_schema=schema,
                                 scope="key", mini_batch=2),
-            name="keyed_train", parallelism=2,
+            name="keyed_train", parallelism=args.par,
         )
     )
 
@@ -121,6 +121,7 @@ def main():
     p.add_argument("--job", default="keyed_sum",
                    choices=("keyed_sum", "keyed_window", "keyed_train"))
     p.add_argument("--window", type=int, default=5)
+    p.add_argument("--par", type=int, default=2, help="keyed-stage parallelism")
     args = p.parse_args()
 
     ports = [int(x) for x in args.ports.split(",")]
@@ -137,7 +138,7 @@ def main():
         stage = (
             env.from_collection(list(range(args.n)), parallelism=1)
             .key_by(lambda x: x % NUM_KEYS)
-            .process(KeyedSum(), name="keyed_sum", parallelism=2)
+            .process(KeyedSum(), name="keyed_sum", parallelism=args.par)
         )
     else:
         keyed = (
@@ -152,7 +153,7 @@ def main():
         # by tests/test_adaptive_batching.py); it still exercises the
         # adaptive trigger's code path through the plane.
         stage = keyed.count_window(args.window, latency_budget_s=600.0).apply(
-            WindowSum(), name="keyed_window", parallelism=2)
+            WindowSum(), name="keyed_window", parallelism=args.par)
     stage.add_sink(ExactlyOnceRecordFileSink(args.out), name="sink", parallelism=1)
     kw = {}
     if args.restore_id >= 0:

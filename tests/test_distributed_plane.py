@@ -231,13 +231,13 @@ class TestManualTriggerForbidden:
 
 
 def _spawn(index, ports, out, chk=None, n=80, every=20, restore_id=-1,
-           throttle=0.0, job="keyed_sum", window=5):
+           throttle=0.0, job="keyed_sum", window=5, par=2):
     cmd = [
         sys.executable, _WORKER, "--index", str(index),
         "--ports", ",".join(map(str, ports)), "--out", out,
         "--n", str(n), "--every", str(every),
         "--restore-id", str(restore_id), "--throttle", str(throttle),
-        "--job", job, "--window", str(window),
+        "--job", job, "--window", str(window), "--par", str(par),
     ]
     if chk:
         cmd += ["--chk", chk]
@@ -303,6 +303,27 @@ class TestTwoProcessJob:
             for r in read_committed(out)
         )
         assert got == expected_windows(n, window)
+
+    def test_three_process_cohort(self, tmp_path):
+        """3 processes, keyed stage parallelism 3: every process owns a
+        subtask, the commit gate waits on TWO peers per checkpoint, and
+        the running-sum output is still exactly-once."""
+        ports = _free_ports(3)
+        out = str(tmp_path / "out")
+        chk = str(tmp_path / "chk")
+        procs = [
+            _spawn(i, ports, out, chk=chk, n=96, every=24, par=3)
+            for i in range(3)
+        ]
+        results = [_wait(p) for p in procs]
+        for rc, log in results:
+            assert rc == 0, f"worker failed:\n{log}"
+        assert _read_sorted(out) == expected_emissions(96)
+        # Every process persisted shards for the shared checkpoint ids.
+        from flink_tensorflow_tpu.parallel import latest_common_checkpoint
+
+        dirs = [os.path.join(chk, f"proc-{i:05d}") for i in range(3)]
+        assert latest_common_checkpoint(dirs) is not None
 
     def test_keyed_online_training_spans_processes(self, tmp_path):
         """The reference's Wide&Deep shape (keyed stream, per-key SGD,
