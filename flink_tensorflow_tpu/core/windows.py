@@ -7,8 +7,11 @@ feeds one ``jax.jit`` call on a ``[B, ...]`` array (SURVEY.md §3.2), so the
 window/trigger design directly controls MXU utilization and p50 latency:
 
 - count trigger  -> fixed batch B (full MXU tiles, best throughput)
-- timeout hybrid -> flush on count OR deadline (bounds p50 latency; see
-  SURVEY.md §7 hard part 3 "adaptive batching")
+- timeout hybrid -> flush on count OR deadline (bounds p50 latency)
+- adaptive latency trigger -> EWMA arrival-rate projection flushes
+  partial windows that provably can't fill inside the latency budget
+  (SURVEY.md §7 hard part 3 "adaptive batching" — the latency-TARGETING
+  policy)
 """
 
 from __future__ import annotations

@@ -1,12 +1,13 @@
 """Worker process for the distributed record-plane tests.
 
-Runs ONE process of a 2-process cohort executing
-``source -> key_by -> keyed sum (parallelism 2) -> 2PC file sink`` with
-NO RemoteSink/RemoteSource anywhere: subtask placement and the
+Runs ONE process of an N-process cohort executing
+``source -> key_by -> keyed stage (--job: running sum / count window /
+per-key SGD; --par subtasks) -> 2PC file sink`` with NO
+RemoteSink/RemoteSource anywhere: subtask placement and the
 cross-process channels come from the record plane itself
-(core/distributed.py).  The keyed edge spans processes — records whose
-key group routes to the peer's subtask cross the shuffle, and
-checkpoint barriers flow through the same channels.
+(core/distributed.py).  Keyed edges span processes — records whose key
+group routes to a peer's subtask cross the shuffle, and checkpoint
+barriers flow through the same channels.
 """
 
 import argparse
