@@ -109,6 +109,10 @@ def write_checkpoint(
     meta = {
         "checkpoint_id": checkpoint_id,
         "tasks": {task: sorted(per_sub.keys()) for task, per_sub in snapshots.items()},
+        # Cohort shape (distributed shards): lets restore validate the
+        # shard set and pick same-shape fast paths WITHOUT unpickling
+        # the state payloads.
+        "job": snapshots.get("__job__", {}).get(0, {}),
     }
     with open(os.path.join(tmp, "METADATA.json"), "w") as f:
         json.dump(meta, f, indent=2)
@@ -158,3 +162,135 @@ def read_checkpoint(
             raise FileNotFoundError(f"no checkpoints under {base_dir}")
     with open(os.path.join(_chk_dir(base_dir, checkpoint_id), "state.pkl"), "rb") as f:
         return checkpoint_id, _rebuild_keys(pickle.load(f))
+
+
+def cohort_process_dirs(base_dir: str) -> typing.List[str]:
+    """The per-process shard directories a distributed cohort wrote under
+    one shared checkpoint base (``proc-00000``, ``proc-00001``, ...)."""
+    if not os.path.isdir(base_dir):
+        return []
+    return sorted(
+        os.path.join(base_dir, name)
+        for name in os.listdir(base_dir)
+        if name.startswith("proc-") and os.path.isdir(os.path.join(base_dir, name))
+    )
+
+
+def read_shard_meta(shard_dir: str, checkpoint_id: int) -> typing.Optional[dict]:
+    """A shard's METADATA.json for one checkpoint (no state unpickling);
+    None when the checkpoint or the metadata file is absent (pre-r3
+    shards carry no metadata for the cohort fields)."""
+    path = os.path.join(_chk_dir(shard_dir, checkpoint_id), "METADATA.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _complete_shard_set(
+    dirs: typing.Sequence[str], checkpoint_id: int,
+    ids_by_dir: typing.Optional[typing.Mapping[str, typing.Set[int]]] = None,
+) -> typing.Optional[typing.List[str]]:
+    """The shard directories forming a COMPLETE cohort snapshot of
+    ``checkpoint_id``, or None.
+
+    Completeness comes from the cohort shape each shard RECORDED at
+    write time (num_processes + process_index in METADATA.json): the
+    shards holding the id must all agree on num_processes P and cover
+    process indices 0..P-1 exactly.  A directory listing alone cannot
+    distinguish "cohort of 2" from "cohort of 3 minus a lost shard" —
+    and a stale shard from a previous cohort shape (which simply lacks
+    this id) must not veto the id.  Shards written before the shape was
+    recorded fall back to the old rule: the id must be present in EVERY
+    proc-* directory.
+    """
+    if ids_by_dir is None:
+        ids_by_dir = {d: set(checkpoint_ids(d)) for d in dirs}
+    having = [d for d in dirs if checkpoint_id in ids_by_dir[d]]
+    if not having:
+        return None
+    metas = [read_shard_meta(d, checkpoint_id) for d in having]
+    shapes = [(m or {}).get("job", {}).get("num_processes") for m in metas]
+    if any(p is None for p in shapes):
+        # Legacy shards: no recorded shape — complete iff universal.
+        return having if len(having) == len(dirs) else None
+    if len(set(shapes)) != 1:
+        return None
+    expected = shapes[0]
+    indices = {(m or {}).get("job", {}).get("process_index") for m in metas}
+    if len(having) == expected and indices == set(range(expected)):
+        return having
+    return None
+
+
+def select_cohort_checkpoint(
+    base_dir: str, checkpoint_id: typing.Optional[int] = None
+) -> typing.Tuple[int, typing.List[str]]:
+    """Pick ``(checkpoint_id, complete shard dirs)`` under a shared
+    cohort base — metadata-only (no state unpickling).  With
+    ``checkpoint_id=None``: the highest id with a complete shard set;
+    an explicit id with an incomplete set raises loudly."""
+    dirs = cohort_process_dirs(base_dir)
+    if not dirs:
+        raise FileNotFoundError(f"no proc-* shard directories under {base_dir}")
+    # One directory listing per shard, shared across candidate ids.
+    ids_by_dir = {d: set(checkpoint_ids(d)) for d in dirs}
+    if checkpoint_id is None:
+        candidates: typing.Set[int] = set()
+        for ids in ids_by_dir.values():
+            candidates.update(ids)
+        for cid in sorted(candidates, reverse=True):
+            shard_set = _complete_shard_set(dirs, cid, ids_by_dir)
+            if shard_set is not None:
+                return cid, shard_set
+        raise FileNotFoundError(
+            f"no checkpoint under {base_dir} has a complete cohort shard set"
+        )
+    shard_set = _complete_shard_set(dirs, checkpoint_id, ids_by_dir)
+    if shard_set is None:
+        raise ValueError(
+            f"checkpoint {checkpoint_id} under {base_dir} has an INCOMPLETE "
+            "cohort shard set (a process's shard is missing or shards "
+            "disagree on the cohort shape) — restoring it would silently "
+            "drop that shard's state"
+        )
+    return checkpoint_id, shard_set
+
+
+def read_cohort_checkpoint(
+    base_dir: str, checkpoint_id: typing.Optional[int] = None
+) -> typing.Tuple[int, typing.Dict[str, typing.Dict[int, typing.Any]]]:
+    """Merge the per-process shards of checkpoint ``checkpoint_id`` under
+    a SHARED cohort base directory into one global snapshot mapping.
+
+    Every process of a distributed job persists only its own subtasks'
+    state (``proc-NNNNN/chk-XXXXXX``); merging the shards reconstructs
+    the full {task: {subtask: state}} view — what cohort RESCALING needs
+    (restoring with a different process count or operator parallelism
+    redistributes keyed state by key group, which requires every old
+    subtask's shard, not just the local one).
+
+    ``checkpoint_id=None`` selects the HIGHEST id whose shard set is
+    complete per the cohort shape recorded in the shards themselves
+    (see ``_complete_shard_set`` — a lost shard makes an id ineligible
+    rather than silently restoring partial state, and stale shards from
+    a previous cohort shape neither veto nor pollute newer ids).  An
+    explicit id with an incomplete shard set raises loudly.
+    """
+    checkpoint_id, shard_set = select_cohort_checkpoint(base_dir, checkpoint_id)
+    merged: typing.Dict[str, typing.Dict[int, typing.Any]] = {}
+    for d in shard_set:
+        _, snapshots = read_checkpoint(d, checkpoint_id)
+        for task, subtasks in snapshots.items():
+            into = merged.setdefault(task, {})
+            for idx, snap in subtasks.items():
+                if task != "__job__" and idx in into:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_id}: subtask {task}.{idx} "
+                        f"appears in more than one shard under {base_dir} — "
+                        "shards from different cohort shapes are mixed; "
+                        "use a fresh checkpoint base per job lineage"
+                    )
+                into[idx] = snap
+    return checkpoint_id, merged

@@ -68,6 +68,11 @@ class CheckpointCoordinator:
         #: is not yet durable on every process); staged sink transactions
         #: then promote via a later checkpoint, clean finish, or restore.
         self.commit_gate: typing.Optional[typing.Callable[[int], bool]] = None
+        #: Extra fields persisted in the __job__ snapshot entry (and the
+        #: shard's METADATA.json) — the distributed executor records the
+        #: cohort shape here so restore can validate shard-set
+        #: completeness instead of inferring it from a directory listing.
+        self.job_meta_extra: typing.Dict[str, typing.Any] = {}
         self._next_id = 1
         self._lock = threading.Lock()
         #: Serializes whole trigger() calls: a trigger arriving while one
@@ -131,7 +136,8 @@ class CheckpointCoordinator:
         (the hash routing changes; Flink pins maxParallelism the same way)."""
         return {
             **snapshots,
-            "__job__": {0: {"max_parallelism": self.executor.max_parallelism}},
+            "__job__": {0: {"max_parallelism": self.executor.max_parallelism,
+                            **self.job_meta_extra}},
         }
 
     def _seed_finished(self, pending: _PendingCheckpoint) -> None:

@@ -24,10 +24,13 @@ the TPU framework:
   local subtasks' state under the shared checkpoint id; barrier ids
   originate at sources (count-based triggers) and reach peer processes
   through the remote channels (``CheckpointCoordinator.lazy_register``).
-  Restore: each process restores its own shard — placement is a pure
-  function of (subtask index, num_processes), so the same cohort shape
-  finds its state; changing ``num_processes`` across a restore is
-  rejected rather than silently dropping peer-held keyed state.
+  Restore: a same-shape cohort restores each process from its own shard
+  (placement is a pure function of subtask index and num_processes);
+  a CHANGED shape — cohort grew/shrank or an operator's parallelism
+  moved — merges every shard from the shared base and redistributes
+  keyed state by key group (cohort rescaling; shard-set completeness is
+  validated against the cohort shape each shard recorded at write time,
+  so a lost shard is a loud error, never silent state loss).
 
 Gang operators (one jitted step spanning the cohort's global mesh —
 DP/TP training) place one subtask per process when their parallelism
@@ -47,7 +50,6 @@ import time
 import typing
 
 from flink_tensorflow_tpu.core.graph import DataflowGraph, Transformation
-from flink_tensorflow_tpu.core.operators import StateNotRescalable
 from flink_tensorflow_tpu.core.runtime import LocalExecutor
 from flink_tensorflow_tpu.core.shuffle import RemoteChannelWriter, ShuffleServer
 
@@ -164,6 +166,17 @@ class DistributedExecutor(LocalExecutor):
             raise
         self.coordinator.lazy_register = True
         self.coordinator.commit_gate = self._global_commit_gate
+        # Record the cohort shape in every shard: restore validates the
+        # shard set against it (a MISSING shard must be a loud error,
+        # never silently reinterpreted as a parallelism change) and
+        # same-shape restores can skip the cohort merge entirely.
+        self.coordinator.job_meta_extra = {
+            "num_processes": self.dist.num_processes,
+            "process_index": self.dist.process_index,
+            "task_parallelism": {
+                t.name: t.parallelism for t in graph.transformations
+            },
+        }
         #: Processes owning >= 1 subtask under round-robin placement —
         #: exactly those whose durability report a commit must await
         #: (p owns subtask p of any transformation with parallelism > p).
@@ -302,21 +315,9 @@ class DistributedExecutor(LocalExecutor):
             self._server.close()
 
     # -- restore ---------------------------------------------------------
-    def restore(self, snapshots, from_checkpoint_id=None) -> None:
-        local_counts: typing.Dict[str, int] = {}
-        for st in self.subtasks:
-            local_counts[st.t.name] = local_counts.get(st.t.name, 0) + 1
-        for task, snaps in snapshots.items():
-            if task == "__job__":
-                continue
-            expected = local_counts.get(task)
-            if expected is not None and len(snaps) != expected:
-                raise StateNotRescalable(
-                    f"checkpoint shard for {task!r} holds {len(snaps)} "
-                    f"subtask states but this process owns {expected} — "
-                    "the cohort size (num_processes) changed across the "
-                    "restore; peer-held state cannot be redistributed "
-                    "from one process's shard. Restore with the original "
-                    "cohort shape."
-                )
-        super().restore(snapshots, from_checkpoint_id)
+    # Restore receives the MERGED cohort snapshot (environment reads and
+    # merges every process's shard under the shared base —
+    # checkpoint/store.read_cohort_checkpoint), so the base-class logic
+    # applies unchanged: matching shapes restore each local subtask by
+    # index; a changed cohort/operator parallelism redistributes keyed
+    # state by key group (per-subtask state raises StateNotRescalable).

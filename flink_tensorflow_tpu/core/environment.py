@@ -339,10 +339,43 @@ class StreamExecutionEnvironment:
         if restore_from is not None:
             from flink_tensorflow_tpu.checkpoint.store import read_checkpoint
 
-            cid, snapshots = read_checkpoint(
-                self._resolve_checkpoint_location(restore_from),
-                restore_checkpoint_id,
-            )
-            executor.restore(snapshots, from_checkpoint_id=cid)
+            local_shard = False
+            if self.config.distributed is not None:
+                from flink_tensorflow_tpu.checkpoint.store import (
+                    read_cohort_checkpoint,
+                    read_shard_meta,
+                    select_cohort_checkpoint,
+                )
+
+                dist = self.config.distributed
+                # Metadata-only selection: highest id with a COMPLETE
+                # cohort shard set (a lost shard makes an id ineligible
+                # instead of silently dropping its state).
+                cid, _ = select_cohort_checkpoint(
+                    restore_from, restore_checkpoint_id
+                )
+                own_dir = dist.process_checkpoint_dir(restore_from)
+                job = (read_shard_meta(own_dir, cid) or {}).get("job", {})
+                current = {t.name: t.parallelism
+                           for t in self.graph.transformations}
+                local_shard = (
+                    job.get("num_processes") == dist.num_processes
+                    and job.get("process_index") == dist.process_index
+                    and job.get("task_parallelism") == current
+                )
+                if local_shard:
+                    # Same cohort shape and operator parallelisms: this
+                    # process's own shard holds exactly its subtasks —
+                    # no need to unpickle every peer's state.
+                    cid, snapshots = read_checkpoint(own_dir, cid)
+                else:
+                    # Shape changed (cohort grew/shrank or an operator's
+                    # parallelism moved): merge ALL shards so keyed
+                    # state can redistribute by key group.
+                    cid, snapshots = read_cohort_checkpoint(restore_from, cid)
+            else:
+                cid, snapshots = read_checkpoint(restore_from, restore_checkpoint_id)
+            executor.restore(snapshots, from_checkpoint_id=cid,
+                             local_shard=local_shard)
         executor.start()
         return JobHandle(executor)

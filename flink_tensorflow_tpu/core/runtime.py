@@ -399,7 +399,15 @@ class LocalExecutor:
         self,
         snapshots: typing.Dict[str, typing.Dict[int, typing.Any]],
         from_checkpoint_id: typing.Optional[int] = None,
+        *,
+        local_shard: bool = False,
     ) -> None:
+        """``local_shard=True``: ``snapshots`` holds exactly THIS
+        process's subtasks (a distributed same-shape restore from the
+        process's own shard — the caller validated the shape against the
+        shard's recorded metadata), so each local subtask restores by
+        index and the rescale inference must not run (per-task counts
+        are local, not the old global parallelism)."""
         if from_checkpoint_id is not None:
             # New checkpoints must never overwrite the restore point.
             self.coordinator.resume_from(from_checkpoint_id)
@@ -421,7 +429,11 @@ class LocalExecutor:
             if task_snaps is None:
                 continue
             old_parallelism = len(task_snaps)
-            if old_parallelism == len(sts):
+            # The NEW parallelism is the transformation's declared one —
+            # on a distributed executor the local subtask list is only
+            # this process's share of it.
+            new_parallelism = sts[0].t.parallelism
+            if local_shard or old_parallelism == new_parallelism:
                 for st in sts:
                     snap = task_snaps.get(st.index)
                     if snap is not None:
@@ -433,7 +445,8 @@ class LocalExecutor:
                 for st in sts:
                     st.operator.restore(
                         st.operator.rescale(
-                            task_snaps, st.index, len(sts), self.max_parallelism
+                            task_snaps, st.index, new_parallelism,
+                            self.max_parallelism,
                         )
                     )
 

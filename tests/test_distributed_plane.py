@@ -211,6 +211,66 @@ class TestPlacement:
             DistributedConfig(0, 1, ("nocolon",)).validate()
 
 
+class TestCohortShardSelection:
+    """select_cohort_checkpoint picks restore points by SHARD-SET
+    completeness against the cohort shape each shard recorded — a lost
+    shard makes an id ineligible (never silent partial restore), and
+    stale shards from a previous cohort shape neither veto nor pollute
+    newer ids."""
+
+    @staticmethod
+    def _write(base, proc, cid, num_processes, tasks):
+        from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
+
+        job = {0: {"max_parallelism": 128, "num_processes": num_processes,
+                   "process_index": proc, "task_parallelism": {}}}
+        snaps = {"__job__": job}
+        for task, idx in tasks:
+            snaps.setdefault(task, {})[idx] = {"x": idx}
+        write_checkpoint(os.path.join(base, f"proc-{proc:05d}"), cid, snaps)
+
+    def test_highest_complete_id_wins_over_partial_newer(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import select_cohort_checkpoint
+
+        base = str(tmp_path)
+        for cid in (1, 2):
+            for p in range(2):
+                self._write(base, p, cid, 2, [("op", p)])
+        self._write(base, 0, 3, 2, [("op", 0)])  # cid 3 only on proc 0
+        cid, shards = select_cohort_checkpoint(base)
+        assert cid == 2 and len(shards) == 2
+
+    def test_explicit_incomplete_id_raises(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import select_cohort_checkpoint
+
+        base = str(tmp_path)
+        self._write(base, 0, 1, 2, [("op", 0)])  # proc 1's shard lost
+        with pytest.raises(ValueError, match="INCOMPLETE"):
+            select_cohort_checkpoint(base, 1)
+
+    def test_stale_shard_does_not_veto(self, tmp_path):
+        """Cohort shrank 3 -> 2 reusing the base: the stale proc-00002
+        dir (old cids only) must not veto the new 2-shard cids."""
+        from flink_tensorflow_tpu.checkpoint.store import select_cohort_checkpoint
+
+        base = str(tmp_path)
+        for p in range(3):
+            self._write(base, p, 1, 3, [("op", p)])
+        for p in range(2):
+            self._write(base, p, 2, 2, [("op", p)])
+        cid, shards = select_cohort_checkpoint(base)
+        assert cid == 2 and len(shards) == 2
+
+    def test_merge_covers_all_shards(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import read_cohort_checkpoint
+
+        base = str(tmp_path)
+        for p in range(3):
+            self._write(base, p, 1, 3, [("op", p)])
+        cid, snaps = read_cohort_checkpoint(base)
+        assert cid == 1 and sorted(snaps["op"]) == [0, 1, 2]
+
+
 class TestManualTriggerForbidden:
     def test_manual_checkpoint_rejected_on_distributed_job(self, tmp_path):
         """A manual trigger reaches only local sources and bypasses the
@@ -354,6 +414,50 @@ class TestTwoProcessJob:
         assert {k: len(v) for k, v in per_key.items()} == expected_steps
         for k, steps in per_key.items():
             assert sorted(steps) == list(range(1, expected_steps[k] + 1))
+
+    def test_cohort_rescale_on_restore(self, tmp_path):
+        """Kill a 2-process cohort mid-stream, restart as a THREE-process
+        cohort (keyed parallelism 2 -> 3) restoring from the latest
+        common checkpoint: every process merges all old shards from the
+        shared base and keyed state redistributes by key group —
+        committed output is still exactly-once."""
+        from flink_tensorflow_tpu.parallel import latest_common_checkpoint
+
+        out = str(tmp_path / "out")
+        shared_chk = str(tmp_path / "chk")
+        old_dirs = [os.path.join(shared_chk, f"proc-{i:05d}") for i in range(2)]
+        n, every = 240, 40
+        ports = _free_ports(2)
+        procs = [
+            _spawn(i, ports, out, chk=shared_chk, n=n, every=every,
+                   throttle=0.005)
+            for i in range(2)
+        ]
+        deadline = time.monotonic() + 60.0
+        common = None
+        while time.monotonic() < deadline:
+            common = latest_common_checkpoint(old_dirs)
+            if common is not None:
+                break
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.02)
+        assert common is not None, "no common checkpoint before exit"
+        procs[0].send_signal(signal.SIGKILL)
+        for p in procs:
+            _wait(p)
+
+        common = latest_common_checkpoint(old_dirs)
+        ports3 = _free_ports(3)
+        procs = [
+            _spawn(i, ports3, out, chk=shared_chk, n=n, every=every,
+                   restore_id=common, par=3)
+            for i in range(3)
+        ]
+        results = [_wait(p) for p in procs]
+        for rc, log in results:
+            assert rc == 0, f"rescaled worker failed:\n{log}"
+        assert _read_sorted(out) == expected_emissions(n)
 
     @pytest.mark.parametrize("victim", [1, 0])
     def test_kill_and_restore_exactly_once(self, tmp_path, victim):
