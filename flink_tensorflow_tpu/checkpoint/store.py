@@ -11,6 +11,7 @@ point.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import shutil
@@ -162,6 +163,43 @@ def read_checkpoint(
             raise FileNotFoundError(f"no checkpoints under {base_dir}")
     with open(os.path.join(_chk_dir(base_dir, checkpoint_id), "state.pkl"), "rb") as f:
         return checkpoint_id, _rebuild_keys(pickle.load(f))
+
+
+def prune_checkpoints(base_dir: str, keep_last: int) -> typing.List[int]:
+    """Delete all but the newest ``keep_last`` completed checkpoints
+    under ``base_dir``; returns the deleted ids (Flink's retained-
+    checkpoints policy).
+
+    Deletion is oldest-first, best-effort, and ATOMIC with respect to
+    ``checkpoint_ids``: the directory is renamed to ``.pruning`` (one
+    journaled operation that removes it from the completed set) before
+    the recursive delete, so a partially-failed rmtree can never leave a
+    torn ``chk-N`` that restore would select and then fail on — the
+    same either-absent-or-complete invariant the fsync+rename write
+    path guarantees."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    ids = checkpoint_ids(base_dir)
+    deleted = []
+    for cid in ids[:-keep_last]:
+        final = _chk_dir(base_dir, cid)
+        doomed = final + ".pruning"
+        try:
+            if os.path.exists(doomed):
+                shutil.rmtree(doomed)
+            os.rename(final, doomed)
+        except OSError:  # pragma: no cover - fs race/permissions
+            logging.getLogger(__name__).warning(
+                "could not prune checkpoint %d under %s", cid, base_dir,
+                exc_info=True,
+            )
+            continue
+        deleted.append(cid)
+        try:
+            shutil.rmtree(doomed)
+        except OSError:  # pragma: no cover - reaped by a later prune
+            pass
+    return deleted
 
 
 def cohort_process_dirs(base_dir: str) -> typing.List[str]:

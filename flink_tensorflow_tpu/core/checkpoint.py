@@ -175,6 +175,7 @@ class CheckpointCoordinator:
         # Durable (or in-memory-complete): fire the commit signal for
         # two-phase sinks.  Durability-before-notify is the 2PC order.
         self.executor.notify_checkpoint_complete(cid)
+        self._prune()
         return pending.snapshots
 
     def begin_source_checkpoint(self, checkpoint_id: int) -> bool:
@@ -239,6 +240,9 @@ class CheckpointCoordinator:
                         pending.checkpoint_id):
                     return
                 self.executor.notify_checkpoint_complete(pending.checkpoint_id)
+                # Retention runs only behind a durable-and-notified newer
+                # checkpoint (on a cohort: behind its GLOBAL commit).
+                self._prune()
 
         if self._persist_pool is None:
             import concurrent.futures
@@ -247,6 +251,18 @@ class CheckpointCoordinator:
                 max_workers=1, thread_name_prefix="chk-persist"
             )
         self._persist_futures.append(self._persist_pool.submit(job))
+
+    def _prune(self) -> None:
+        """Apply the retained-checkpoints policy (keep the newest N on
+        disk) — called only after a newer checkpoint is durable AND its
+        notifications fired, so nothing a 2PC sink still depends on can
+        disappear."""
+        retain = getattr(self.executor, "checkpoint_retain_last", None)
+        if retain is None or self.checkpoint_dir is None:
+            return
+        from flink_tensorflow_tpu.checkpoint.store import prune_checkpoints
+
+        prune_checkpoints(self.checkpoint_dir, retain)
 
     def wait_for_persistence(self, timeout: typing.Optional[float] = 60.0) -> int:
         """Block until every completed checkpoint has landed on disk.

@@ -36,6 +36,18 @@ class CheckpointConfig:
     every_n_records: typing.Optional[int] = None
     #: Budget for one aligned checkpoint to drain.
     timeout_s: float = 60.0
+    #: Keep only the newest N completed checkpoints on disk (Flink's
+    #: retained-checkpoints policy); None keeps everything.  Pruning
+    #: happens after a NEWER checkpoint is durable (and, on a
+    #: DistributedExecutor cohort, after its GLOBAL 2PC commit fired,
+    #: so every peer holds the retained ids too).  CAUTION for
+    #: hand-rolled CohortSupervisor cohorts (independent per-worker
+    #: executors, per-worker dirs, no global gate): each worker prunes
+    #: alone, so size retain_last comfortably above the worst-case
+    #: cross-worker checkpoint skew (>= 3 recommended) or the
+    #: latest-COMMON-checkpoint restore point can be pruned away on the
+    #: fastest worker.
+    retain_last: typing.Optional[int] = None
 
     def validate(self) -> None:
         if self.interval_s is not None:
@@ -57,6 +69,13 @@ class CheckpointConfig:
                 )
         if self.timeout_s <= 0:
             raise ValueError(f"checkpoint.timeout_s must be > 0, got {self.timeout_s}")
+        if self.retain_last is not None:
+            if self.dir is None:
+                raise ValueError("checkpoint.retain_last requires checkpoint.dir")
+            if self.retain_last < 1:
+                raise ValueError(
+                    f"checkpoint.retain_last must be >= 1, got {self.retain_last}"
+                )
 
 
 @dataclasses.dataclass(frozen=True)

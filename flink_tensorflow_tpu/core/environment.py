@@ -96,16 +96,19 @@ class StreamExecutionEnvironment:
     def enable_checkpointing(
         self, checkpoint_dir: str, interval_s: typing.Optional[float] = None,
         *, every_n_records: typing.Optional[int] = None,
+        retain_last: typing.Optional[int] = None,
     ) -> "StreamExecutionEnvironment":
         """Persist aligned snapshots under ``checkpoint_dir``; with
         ``interval_s`` they trigger periodically (Flink's checkpoint
         interval), with ``every_n_records`` at deterministic source
         positions (the multi-host mode — see CheckpointCoordinator),
-        otherwise only on explicit ``trigger_checkpoint``."""
+        otherwise only on explicit ``trigger_checkpoint``.
+        ``retain_last`` keeps only the newest N checkpoints on disk
+        (pruned after a newer one is durable and notified)."""
         return self.configure(
             checkpoint=dataclasses.replace(
                 self.config.checkpoint, dir=checkpoint_dir, interval_s=interval_s,
-                every_n_records=every_n_records,
+                every_n_records=every_n_records, retain_last=retain_last,
             )
         )
 
@@ -246,6 +249,7 @@ class StreamExecutionEnvironment:
             checkpoint_dir=self._resolve_checkpoint_location(cfg.checkpoint.dir),
             checkpoint_every_n=cfg.checkpoint.every_n_records,
             checkpoint_timeout_s=cfg.checkpoint.timeout_s,
+            checkpoint_retain_last=cfg.checkpoint.retain_last,
             max_parallelism=cfg.max_parallelism,
         )
         if cfg.distributed is not None:

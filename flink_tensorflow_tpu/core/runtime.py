@@ -235,6 +235,7 @@ class LocalExecutor:
         checkpoint_dir: typing.Optional[str] = None,
         checkpoint_every_n: typing.Optional[int] = None,
         checkpoint_timeout_s: float = 60.0,
+        checkpoint_retain_last: typing.Optional[int] = None,
         max_parallelism: int = 128,
     ):
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
@@ -248,6 +249,7 @@ class LocalExecutor:
         self.source_throttle_s = source_throttle_s
         self.checkpoint_every_n = checkpoint_every_n
         self.checkpoint_timeout_s = checkpoint_timeout_s
+        self.checkpoint_retain_last = checkpoint_retain_last
         self.max_parallelism = max_parallelism
         self.cancelled = threading.Event()
         self._error: typing.Optional[BaseException] = None

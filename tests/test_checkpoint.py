@@ -132,3 +132,82 @@ def test_concurrent_triggers_queue_instead_of_failing():
     assert sorted(handle.executor.coordinator.completed_ids) == [1, 2, 3]
     handle.cancel()
     handle.wait(timeout=30)
+
+
+class TestRetention:
+    """Flink's retained-checkpoints policy: keep the newest N on disk,
+    pruned only behind a durable-and-notified newer checkpoint."""
+
+    def _run(self, d, retain, n=70, every=10):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d, every_n_records=every, retain_last=retain)
+        out = (
+            env.from_collection(list(range(n)), parallelism=1)
+            .map(lambda x: x + 1)
+            .sink_to_list()
+        )
+        env.execute("retention", timeout=60)
+        return out
+
+    def test_prunes_to_newest_n(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import checkpoint_ids
+
+        d = str(tmp_path / "chk")
+        self._run(d, retain=2)
+        # 70 records / every 10 -> checkpoints 1..7; only the newest 2 stay.
+        assert checkpoint_ids(d) == [6, 7]
+
+    def test_restore_from_retained(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import checkpoint_ids
+
+        d = str(tmp_path / "chk")
+        self._run(d, retain=2)
+        cid = checkpoint_ids(d)[-1]
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d, every_n_records=10, retain_last=2)
+        out = (
+            env.from_collection(list(range(70)), parallelism=1)
+            .map(lambda x: x + 1)
+            .sink_to_list()
+        )
+        env.execute("retention-restore", restore_from=d,
+                    restore_checkpoint_id=cid, timeout=60)
+        assert sorted(out) == list(range(cid * 10 + 1, 71))
+
+    def test_retain_validation(self, tmp_path):
+        import pytest
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path), every_n_records=4, retain_last=0)
+        with pytest.raises(ValueError, match="retain_last"):
+            env.config.validate()
+
+    def test_prune_helper_keeps_newest(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import (
+            checkpoint_ids,
+            prune_checkpoints,
+            write_checkpoint,
+        )
+
+        d = str(tmp_path)
+        for cid in range(1, 6):
+            write_checkpoint(d, cid, {"op": {0: {"v": cid}}})
+        deleted = prune_checkpoints(d, keep_last=2)
+        assert deleted == [1, 2, 3]
+        assert checkpoint_ids(d) == [4, 5]
+        assert prune_checkpoints(d, keep_last=2) == []
+
+    def test_manual_trigger_path_prunes(self, tmp_path):
+        from flink_tensorflow_tpu.checkpoint.store import checkpoint_ids
+
+        d = str(tmp_path / "chk")
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(d, retain_last=1)
+        env.configure(source_throttle_s=0.01)
+        env.from_collection(list(range(300)), parallelism=1).map(
+            lambda x: x).sink_to_list()
+        handle = env.execute_async("manual-retention")
+        for _ in range(3):
+            handle.trigger_checkpoint()
+        handle.wait(60)
+        assert len(checkpoint_ids(d)) == 1
