@@ -179,6 +179,16 @@ def prune_checkpoints(base_dir: str, keep_last: int) -> typing.List[int]:
     path guarantees."""
     if keep_last < 1:
         raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    # Reap .pruning orphans first: a crash between the rename and the
+    # recursive delete leaves a directory checkpoint_ids no longer
+    # lists, so nothing else would ever reclaim it.
+    if os.path.isdir(base_dir):
+        for name in os.listdir(base_dir):
+            if name.endswith(".pruning"):
+                try:
+                    shutil.rmtree(os.path.join(base_dir, name))
+                except OSError:  # pragma: no cover - retried next prune
+                    pass
     ids = checkpoint_ids(base_dir)
     deleted = []
     for cid in ids[:-keep_last]:

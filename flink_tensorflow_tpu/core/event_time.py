@@ -101,18 +101,27 @@ class EventTimeWindowOperator(_FunctionOperator):
 
     def __init__(self, name: str, function: fn.WindowFunction, size_s: float,
                  key_selector=None, slide_s: typing.Optional[float] = None,
-                 late_tag: typing.Optional[str] = None):
+                 late_tag: typing.Optional[str] = None,
+                 allowed_lateness_s: float = 0.0):
         super().__init__(name, function)
         if size_s <= 0:
             raise ValueError(f"window size must be positive, got {size_s}")
         if slide_s is not None and slide_s <= 0:
             raise ValueError(f"window slide must be positive, got {slide_s}")
+        if allowed_lateness_s < 0:
+            raise ValueError(
+                f"allowed lateness must be >= 0, got {allowed_lateness_s}")
         self.size = float(size_s)
         self.slide = float(slide_s) if slide_s is not None else float(size_s)
         self.key_selector = key_selector
         #: When set, records too late for EVERY window they'd belong to
         #: are emitted as SideOutput(late_tag, value) instead of dropped.
         self.late_tag = late_tag
+        #: Flink's allowedLateness: a fired window's state survives until
+        #: ``watermark >= end + lateness``; a late arrival inside that
+        #: horizon joins the window and RE-fires it immediately with the
+        #: updated contents (downstream sees an updated result).
+        self.lateness = float(allowed_lateness_s)
         self._buffers: typing.Dict[typing.Tuple[typing.Any, float], WindowBuffer] = {}
         self._watermark = -math.inf
         self._collector: typing.Optional[fn.Collector] = None
@@ -151,14 +160,20 @@ class EventTimeWindowOperator(_FunctionOperator):
         covered = False
         for start, end in self._starts_for(ts):
             covered = True
-            if end <= self._watermark:
-                continue  # that window already fired: late (Flink rule)
+            if end + self.lateness <= self._watermark:
+                continue  # past the lateness horizon: late (Flink rule)
             assigned = True
             buf = self._buffers.get((key, start))
             if buf is None:
                 buf = WindowBuffer(window=TimeWindow(start, end))
                 self._buffers[(key, start)] = buf
             buf.add(record.value, ts)
+            if end <= self._watermark:
+                # The watermark already passed this window's end, but the
+                # record is inside the lateness horizon: late firing —
+                # emit the UPDATED window immediately (Flink re-fires on
+                # each late element).
+                self._fire((key, start))
         if covered and not assigned and self.late_tag is not None:
             # Completely late (every window it belongs to already fired):
             # divert to the side output instead of silent drop.  A record
@@ -169,15 +184,22 @@ class EventTimeWindowOperator(_FunctionOperator):
     def process_watermark(self, watermark: el.Watermark) -> None:
         self._watermark = max(self._watermark, watermark.timestamp)
         due = sorted(
-            (k for k, buf in self._buffers.items() if buf.window.end <= self._watermark),
+            (k for k, buf in self._buffers.items()
+             if buf.window.end <= self._watermark and not buf.fired),
             key=lambda k: (k[1], str(k[0])),
         )
         for k in due:
             self._fire(k)
+        # Purge windows past the lateness horizon: no further late
+        # arrival may join them, so their state is dead.
+        for k in [k for k, buf in self._buffers.items()
+                  if buf.window.end + self.lateness <= self._watermark]:
+            del self._buffers[k]
         self.output.broadcast_element(watermark)
 
     def _fire(self, k) -> None:
-        buf = self._buffers.pop(k)
+        buf = self._buffers[k]
+        buf.fired = True
         key = k[0]
         if self.key_selector is not None:
             self.keyed_state.current_key = key
@@ -190,8 +212,12 @@ class EventTimeWindowOperator(_FunctionOperator):
         )
 
     def finish(self) -> None:
-        for k in sorted(self._buffers.keys(), key=lambda k: (k[1], str(k[0]))):
+        # Fired windows retained by the lateness horizon already emitted
+        # their (possibly late-updated) result — only unfired ones flush.
+        for k in sorted((k for k, buf in self._buffers.items() if not buf.fired),
+                        key=lambda k: (k[1], str(k[0]))):
             self._fire(k)
+        self._buffers.clear()
         self.function.on_finish(self._collector)
 
     def _operator_snapshot(self):
@@ -204,6 +230,16 @@ class EventTimeWindowOperator(_FunctionOperator):
 
         self._watermark = state["watermark"]
         self._buffers = restore_buffers(state["buffers"])
+        # A rescale restore rewinds to the MIN of the old subtasks'
+        # watermarks: a buffer that fired under a further-ahead watermark
+        # may now have end > watermark again.  Clear its fired flag so
+        # the due-fire sweep emits it when the watermark re-passes the
+        # end — a fired-flagged buffer would otherwise absorb replayed
+        # on-time records and silently purge them (re-emission after
+        # restore is the documented at-least-once sink semantics).
+        for buf in self._buffers.values():
+            if buf.fired and buf.window.end > self._watermark:
+                buf.fired = False
 
     def _rescale_operator_state(self, states, mine):
         from flink_tensorflow_tpu.core.operators import StateNotRescalable

@@ -414,10 +414,16 @@ class EventTimeWindowedStream:
         self.key_selector = key_selector
 
     def apply(self, f: fn.WindowFunction, *, name="time_window", parallelism=None,
-              late_tag: typing.Optional[str] = None) -> DataStream:
+              late_tag: typing.Optional[str] = None,
+              allowed_lateness_s: float = 0.0) -> DataStream:
         """``late_tag`` diverts completely-late records to a side output
         (tap with ``result.side_output(late_tag)``) instead of dropping
-        them — Flink's ``sideOutputLateData``."""
+        them — Flink's ``sideOutputLateData``.  ``allowed_lateness_s``
+        keeps a fired window's state alive for that much more event
+        time: late arrivals inside the horizon join the window and
+        RE-fire it with the updated contents (Flink's
+        ``allowedLateness``); only records past ``end + lateness`` are
+        late-tagged/dropped."""
         from flink_tensorflow_tpu.core.event_time import EventTimeWindowOperator
 
         parallelism = parallelism or self.env.default_parallelism
@@ -430,7 +436,8 @@ class EventTimeWindowedStream:
             lambda: EventTimeWindowOperator(name, f, self.size_s,
                                             key_selector=self.key_selector,
                                             slide_s=self.slide_s,
-                                            late_tag=late_tag),
+                                            late_tag=late_tag,
+                                            allowed_lateness_s=allowed_lateness_s),
             parallelism,
             inputs=[edge],
         )

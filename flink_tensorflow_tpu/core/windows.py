@@ -222,6 +222,10 @@ class WindowBuffer:
     #: Number of leading elements carried over from the previous fire
     #: (sliding windows) — triggers count "new" arrivals past this.
     retained: int = 0
+    #: The window already fired at least once (event-time windows kept
+    #: alive by allowed lateness: late arrivals RE-fire; end of input
+    #: must not fire it again).
+    fired: bool = False
 
     def add(self, value: typing.Any, timestamp: typing.Optional[float]) -> None:
         if not self.elements:
@@ -234,7 +238,8 @@ def snapshot_buffers(buffers: typing.Mapping[typing.Any, WindowBuffer]) -> dict:
     """Picklable snapshot of open windows (shared by the count/timeout and
     event-time window operators — one format, one restore path)."""
     return {
-        key: (buf.window, list(buf.elements), list(buf.timestamps), buf.retained)
+        key: (buf.window, list(buf.elements), list(buf.timestamps),
+              buf.retained, buf.fired)
         for key, buf in buffers.items()
     }
 
@@ -242,8 +247,9 @@ def snapshot_buffers(buffers: typing.Mapping[typing.Any, WindowBuffer]) -> dict:
 def restore_buffers(snap: dict) -> typing.Dict[typing.Any, WindowBuffer]:
     out: typing.Dict[typing.Any, WindowBuffer] = {}
     for key, (window, elements, timestamps, *rest) in snap.items():
-        # Pre-sliding-window checkpoints carry no retained count.
-        buf = WindowBuffer(window=window, retained=rest[0] if rest else 0)
+        # Older checkpoints carry no retained count / fired flag.
+        buf = WindowBuffer(window=window, retained=rest[0] if rest else 0,
+                           fired=rest[1] if len(rest) > 1 else False)
         buf.elements = list(elements)
         buf.timestamps = list(timestamps)
         # Restart resets the processing-time clock: timeout triggers count

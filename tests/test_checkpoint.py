@@ -211,3 +211,22 @@ class TestRetention:
             handle.trigger_checkpoint()
         handle.wait(60)
         assert len(checkpoint_ids(d)) == 1
+
+    def test_orphaned_pruning_dir_is_reaped(self, tmp_path):
+        import os
+
+        from flink_tensorflow_tpu.checkpoint.store import (
+            checkpoint_ids,
+            prune_checkpoints,
+            write_checkpoint,
+        )
+
+        d = str(tmp_path)
+        for cid in (1, 2, 3):
+            write_checkpoint(d, cid, {"op": {0: {"v": cid}}})
+        # Simulate a crash between rename and rmtree.
+        os.rename(os.path.join(d, "chk-000001"),
+                  os.path.join(d, "chk-000001.pruning"))
+        assert checkpoint_ids(d) == [2, 3]
+        prune_checkpoints(d, keep_last=2)
+        assert not any(n.endswith(".pruning") for n in os.listdir(d))

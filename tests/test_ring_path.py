@@ -170,7 +170,7 @@ class TestRingCheckpoint:
         assert 0 < offset < N, offset
         # Buffered window elements must be concrete values in the snapshot.
         for sub in snaps["window"].values():
-            for _, elements, _, _ in sub["operator"]["buffers"].values():
+            for _, elements, *_ in sub["operator"]["buffers"].values():
                 assert all(isinstance(e, TensorValue) for e in elements)
         handle.cancel()
         handle.wait(timeout=60)

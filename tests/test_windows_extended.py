@@ -285,3 +285,79 @@ class TestSessionWindows:
         for key, w in out:
             per_key[key] = max(per_key.get(key, 0), len(w))
         assert per_key == {0: 20, 1: 20, 2: 20}
+
+
+class TestAllowedLateness:
+    """Flink's allowedLateness: a fired window's state survives for the
+    lateness horizon; late arrivals inside it RE-fire the window with
+    updated contents; past the horizon they are late-tagged/dropped."""
+
+    def test_late_arrival_refires_window(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # wm reaches 5 after t=5.0 -> window [0,2) fires with [1.0].
+        # t=1.5 is late but inside lateness 10 -> immediate re-fire with
+        # [1.0, 1.5].  t=20 closes everything.
+        records = [{"t": 1.0}, {"t": 5.0}, {"t": 1.5}, {"t": 20.0}]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .time_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1, allowed_lateness_s=10.0)
+            .sink_to_list()
+        )
+        _run(env)
+        windows = [sorted(r["t"] for r in w) for _, w in out]
+        assert [1.0] in windows, windows           # on-time firing
+        assert [1.0, 1.5] in windows, windows      # late RE-firing
+        # The [0,2) window fired exactly twice (once on time, once late).
+        assert sum(1 for w in windows if w and w[0] < 2.0) == 2
+
+    def test_past_horizon_goes_to_side_output(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        # Window [0,2) ends at 2; lateness 3 -> horizon 5.  wm reaches 10
+        # before t=0.5 arrives: past the horizon -> late-tagged, window
+        # NOT re-fired.
+        records = [{"t": 1.0}, {"t": 10.0}, {"t": 0.5}, {"t": 20.0}]
+        result = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .time_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1, late_tag="late",
+                   allowed_lateness_s=3.0)
+        )
+        main = result.sink_to_list()
+        late = result.side_output("late").sink_to_list()
+        _run(env)
+        windows = [sorted(r["t"] for r in w) for _, w in main]
+        assert sum(1 for w in windows if w and w[0] < 2.0) == 1
+        assert [r["t"] for r in late] == [0.5]
+
+    def test_fired_flag_survives_snapshot_roundtrip(self):
+        from flink_tensorflow_tpu.core.windows import (
+            WindowBuffer,
+            restore_buffers,
+            snapshot_buffers,
+        )
+
+        buf = WindowBuffer(window=("w", 0.0), fired=True)
+        buf.add("a", 0.5)
+        restored = restore_buffers(snapshot_buffers({("k", 0.0): buf}))
+        assert restored[("k", 0.0)].fired is True
+        # Legacy snapshots without the flag restore as unfired.
+        legacy = {("k", 0.0): (("w", 0.0), ["a"], [0.5])}
+        assert restore_buffers(legacy)[("k", 0.0)].fired is False
+
+    def test_zero_lateness_unchanged(self):
+        """Default lateness 0: the old fire-and-purge behavior exactly."""
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = [{"t": 1.0}, {"t": 5.0}, {"t": 1.5}, {"t": 20.0}]
+        out = (
+            env.from_collection(records, parallelism=1)
+            .assign_timestamps(lambda r: r["t"], watermark_every=1)
+            .time_window_all(2.0)
+            .apply(Collect(), name="w", parallelism=1)
+            .sink_to_list()
+        )
+        _run(env)
+        windows = [sorted(r["t"] for r in w) for _, w in out]
+        assert sum(1 for w in windows if w and w[0] < 2.0) == 1  # no re-fire
