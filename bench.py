@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import time
 
 import numpy as np
@@ -384,7 +385,10 @@ def bench_inception(args) -> dict:
     compute_valid = not compute.get("probe_invalid_capped_to_peak")
     compute_rps = compute["records_per_sec"] if compute_valid else None
     steady_per_batch = span / max(1, (records_n - batch) / batch)
-    batch_compute_s = batch / compute_rps if compute_rps else float("nan")
+    # None, not NaN, when the probe is degenerate: json.dumps would emit
+    # a bare NaN token that strict RFC-8259 parsers (jq) reject
+    # (ADVICE r3 low).
+    batch_compute_s = batch / compute_rps if compute_rps else None
 
     out = {
         "metric": "inception_v3_streaming_inference_records_per_sec_per_chip",
@@ -407,7 +411,9 @@ def bench_inception(args) -> dict:
             # dispatch call, so dispatch_s ~= transfer seconds/batch.
             "h2d_plus_dispatch_s_p50": round(dispatch_p50, 5),
             "steady_state_s": round(steady_per_batch, 5),
-            "device_compute_s": round(batch_compute_s, 5),
+            "device_compute_s": (
+                round(batch_compute_s, 5) if batch_compute_s is not None else None
+            ),
             "fixed_call_roundtrip_s": round(rtt_s, 5),
         },
         # Directly measured transport rate (same session, post-run).
@@ -921,10 +927,25 @@ def main(argv=None):
     names = list(WORKLOADS) if args.workload == "all" else [args.workload]
     outputs = []
     for name in names:
-        out = WORKLOADS[name](args)
-        print(json.dumps(out), flush=True)
+        out = _json_safe(WORKLOADS[name](args))
+        # allow_nan=False pins the invariant: the emitted line is strict
+        # RFC-8259 (jq-parsable) — _json_safe already mapped any stray
+        # NaN/inf float to None, so this can only trip on a new bug.
+        print(json.dumps(out, allow_nan=False), flush=True)
         outputs.append(out)
     return outputs[0] if len(outputs) == 1 else outputs
+
+
+def _json_safe(obj):
+    """NaN/±inf → None, recursively: one degenerate probe must degrade a
+    field, never the parseability of the whole bench line (ADVICE r3)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
 
 
 if __name__ == "__main__":

@@ -244,14 +244,20 @@ def _complete_shard_set(
     ``checkpoint_id``, or None.
 
     Completeness comes from the cohort shape each shard RECORDED at
-    write time (num_processes + process_index in METADATA.json): the
-    shards holding the id must all agree on num_processes P and cover
-    process indices 0..P-1 exactly.  A directory listing alone cannot
+    write time (num_processes + participants + process_index in
+    METADATA.json): the shards holding the id must all agree on the
+    shape and cover the recorded PARTICIPANT set exactly.  Participants
+    — the processes owning >= 1 subtask — rather than {0..P-1}, because
+    an over-provisioned cohort (num_processes > max operator
+    parallelism) legally has idle processes that never write a shard;
+    requiring every index would deem each of its checkpoints incomplete
+    forever (ADVICE r3 medium).  A directory listing alone cannot
     distinguish "cohort of 2" from "cohort of 3 minus a lost shard" —
     and a stale shard from a previous cohort shape (which simply lacks
-    this id) must not veto the id.  Shards written before the shape was
-    recorded fall back to the old rule: the id must be present in EVERY
-    proc-* directory.
+    this id) must not veto the id.  Shards that recorded num_processes
+    but no participant set (r3) imply participants = {0..P-1}; shards
+    written before any shape was recorded fall back to the oldest rule:
+    the id must be present in EVERY proc-* directory.
     """
     if ids_by_dir is None:
         ids_by_dir = {d: set(checkpoint_ids(d)) for d in dirs}
@@ -259,15 +265,23 @@ def _complete_shard_set(
     if not having:
         return None
     metas = [read_shard_meta(d, checkpoint_id) for d in having]
-    shapes = [(m or {}).get("job", {}).get("num_processes") for m in metas]
+    jobs = [(m or {}).get("job", {}) for m in metas]
+    shapes = [j.get("num_processes") for j in jobs]
     if any(p is None for p in shapes):
         # Legacy shards: no recorded shape — complete iff universal.
         return having if len(having) == len(dirs) else None
     if len(set(shapes)) != 1:
         return None
-    expected = shapes[0]
-    indices = {(m or {}).get("job", {}).get("process_index") for m in metas}
-    if len(having) == expected and indices == set(range(expected)):
+    expected_participants = {
+        tuple(j["participants"]) if j.get("participants") is not None
+        else tuple(range(shapes[0]))
+        for j in jobs
+    }
+    if len(expected_participants) != 1:
+        return None
+    expected = set(expected_participants.pop())
+    indices = {j.get("process_index") for j in jobs}
+    if len(having) == len(expected) and indices == expected:
         return having
     return None
 

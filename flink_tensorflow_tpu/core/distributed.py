@@ -166,17 +166,6 @@ class DistributedExecutor(LocalExecutor):
             raise
         self.coordinator.lazy_register = True
         self.coordinator.commit_gate = self._global_commit_gate
-        # Record the cohort shape in every shard: restore validates the
-        # shard set against it (a MISSING shard must be a loud error,
-        # never silently reinterpreted as a parallelism change) and
-        # same-shape restores can skip the cohort merge entirely.
-        self.coordinator.job_meta_extra = {
-            "num_processes": self.dist.num_processes,
-            "process_index": self.dist.process_index,
-            "task_parallelism": {
-                t.name: t.parallelism for t in graph.transformations
-            },
-        }
         #: Processes owning >= 1 subtask under round-robin placement —
         #: exactly those whose durability report a commit must await
         #: (p owns subtask p of any transformation with parallelism > p).
@@ -184,6 +173,24 @@ class DistributedExecutor(LocalExecutor):
         self._participants = frozenset(
             p for p in range(self.dist.num_processes) if p < max_par
         )
+        # Record the cohort shape in every shard: restore validates the
+        # shard set against it (a MISSING shard must be a loud error,
+        # never silently reinterpreted as a parallelism change) and
+        # same-shape restores can skip the cohort merge entirely.  The
+        # PARTICIPANT set — not num_processes — is what completeness must
+        # be judged against: an over-provisioned cohort (num_processes >
+        # max operator parallelism) has idle processes that own no
+        # subtasks and never write proc-* shards, so requiring indices
+        # {0..P-1} would deem every checkpoint incomplete and make a
+        # legal cohort permanently unrestorable (ADVICE r3 medium).
+        self.coordinator.job_meta_extra = {
+            "num_processes": self.dist.num_processes,
+            "process_index": self.dist.process_index,
+            "participants": sorted(self._participants),
+            "task_parallelism": {
+                t.name: t.parallelism for t in graph.transformations
+            },
+        }
         for st in self.subtasks:
             if st.gate is not None:
                 self._server.register_gate(st.t.name, st.index, st.gate)
@@ -232,12 +239,23 @@ class DistributedExecutor(LocalExecutor):
         me = self.dist.process_index
         announcement = ("ckpt_durable", checkpoint_id, me)
         for p in sorted(self._participants - {me}):
+            # Cancellation check BETWEEN peer announcements: a peer death
+            # cancels the job concurrently, and without this the gate
+            # could first sit in a fresh control writer's connect-retry
+            # loop for the full connect timeout before noticing
+            # (ADVICE r3 low: teardown stalling the persist thread).
+            if self.cancelled.is_set():
+                return False
             writer = self._control_writers.get(p)
             if writer is None:
                 host, port = self.dist.endpoint(p)
+                # Short connect window: by the time checkpoints commit the
+                # cohort is long up — only a DYING peer is unreachable
+                # here, and the gate should fail fast, not wait out the
+                # cohort-startup grace period.
                 writer = RemoteChannelWriter(
                     host, port, ShuffleServer.CONTROL_TASK, me, 0,
-                    connect_timeout_s=self.dist.connect_timeout_s,
+                    connect_timeout_s=min(5.0, self.dist.connect_timeout_s),
                 )
                 self._control_writers[p] = writer
             try:

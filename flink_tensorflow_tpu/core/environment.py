@@ -355,7 +355,7 @@ class StreamExecutionEnvironment:
                 # Metadata-only selection: highest id with a COMPLETE
                 # cohort shard set (a lost shard makes an id ineligible
                 # instead of silently dropping its state).
-                cid, _ = select_cohort_checkpoint(
+                cid, shard_set = select_cohort_checkpoint(
                     restore_from, restore_checkpoint_id
                 )
                 own_dir = dist.process_checkpoint_dir(restore_from)
@@ -367,11 +367,29 @@ class StreamExecutionEnvironment:
                     and job.get("process_index") == dist.process_index
                     and job.get("task_parallelism") == current
                 )
+                # An idle non-participant of an UNCHANGED shape (the
+                # over-provisioned cohort, ADVICE r3 medium) owns no
+                # subtasks and wrote no shard: restoring it needs only
+                # the job metadata for max-parallelism pinning — never
+                # the full cohort merge (unpickling every peer's state
+                # to restore zero subtasks).
+                shard_job = (
+                    read_shard_meta(shard_set[0], cid) or {}).get("job", {})
+                idle_same_shape = (
+                    not local_shard
+                    and shard_job.get("participants") is not None
+                    and dist.process_index not in shard_job["participants"]
+                    and shard_job.get("num_processes") == dist.num_processes
+                    and shard_job.get("task_parallelism") == current
+                )
                 if local_shard:
                     # Same cohort shape and operator parallelisms: this
                     # process's own shard holds exactly its subtasks —
                     # no need to unpickle every peer's state.
                     cid, snapshots = read_checkpoint(own_dir, cid)
+                elif idle_same_shape:
+                    snapshots = {"__job__": {0: dict(shard_job)}}
+                    local_shard = True
                 else:
                     # Shape changed (cohort grew/shrank or an operator's
                     # parallelism moved): merge ALL shards so keyed

@@ -333,6 +333,13 @@ class RemoteChannelWriter:
     def _connect(self) -> None:
         deadline = time.monotonic() + self.connect_timeout_s
         while True:
+            # A concurrent close() (job cancel) must abort the retry loop
+            # immediately — otherwise teardown can stall behind a writer
+            # spinning on a peer that died (ADVICE r3 low).
+            if self._closed:
+                raise TimeoutError(
+                    f"writer to {self.host}:{self.port} closed during connect"
+                )
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
@@ -340,8 +347,12 @@ class RemoteChannelWriter:
                     f"within {self.connect_timeout_s}s"
                 )
             try:
+                # Attempts are capped (not at the full remaining window)
+                # only so the loop re-polls _closed; 5s keeps teardown
+                # responsive while still riding out a ~1-3s SYN
+                # retransmit on a congested link.
                 self._sock = socket.create_connection(
-                    (self.host, self.port), timeout=remaining
+                    (self.host, self.port), timeout=min(remaining, 5.0)
                 )
                 break
             except OSError:

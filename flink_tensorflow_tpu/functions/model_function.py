@@ -129,6 +129,17 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
     Buckets: partial flushes assemble to the smallest policy bucket
     >= the buffered count (powers of two up to ``micro_batch`` by
     default), padding the remainder, so a flush never recompiles.
+
+    **Watermark interaction (ADVICE r3):** the enclosing operator
+    flushes the in-flight micro-batch before forwarding every
+    watermark — required for event-time safety (results must not
+    arrive "late" behind the watermark that covers them).  With
+    fine-grained watermarks (``assign_timestamps(watermark_every=1)``)
+    this degrades transparent micro-batching to batch-of-1 dispatch.
+    If the downstream has no event-time operators, drop the timestamp
+    assigner; otherwise use ``watermark_every >= micro_batch`` so
+    flushes land on batch boundaries and the pipelined path keeps its
+    throughput.
     """
 
     def __init__(self, model: ModelSource, method: str = "serve", *,
