@@ -71,29 +71,48 @@ def _chip_peak_tflops(dev) -> float | None:
     return best[1] if best else None
 
 
-def _wire_probe(dev, *, smoke: bool = False) -> dict:
+def _wire_probe(dev, *, smoke: bool = False, micro: bool = False) -> dict:
     """Directly measure host->device byte rate to ``dev`` (VERDICT r2 #1a).
 
     The axon tunnel is token-bucket shaped (measured: ~450-700 MB/s
-    burst until a ~100-300MB bucket drains, then ~13 MB/s refill).  The
-    probe runs AFTER the main pipeline pass — whose traffic holds the
-    bucket drained — so ``initial_mb_s`` (first 3 puts) reflects only
-    whatever tokens trickled back, NOT the idle-start burst rate; the
-    load-bearing figure is ``sustained_mb_s`` (trailing-window rate of
-    continuous pushes), which is what the wire ceiling uses.  Each put
-    is forced resident with an on-device reduction before the clock
-    stops — ``device_put`` alone can return on an async ack.
+    burst until a ~100-300MB bucket drains, then ~3-22 MB/s refill).
+    ``initial_mb_s`` (first 3 puts) reflects whatever tokens are in the
+    bucket at probe time; the load-bearing figure is ``sustained_mb_s``
+    (trailing-window rate of continuous pushes), which is what the wire
+    ceiling uses.  Each put is forced resident with an on-device
+    reduction before the clock stops — ``device_put`` alone can return
+    on an async ack.
+
+    **Cache-busting:** every put ships DIFFERENT bytes (a cycled pool of
+    distinct chunks, each additionally stamped with the put counter).
+    The tunnel has been observed serving repeated identical transfers
+    anomalously fast (content dedup/caching); a probe pushing one buffer
+    in a loop would measure the cache, not the wire.  ``micro=True``
+    runs a shorter pass (for bracketing probes around latency-sensitive
+    phases without draining minutes of token budget).
     """
     import jax
     import jax.numpy as jnp
 
     chunk_mb = 1 if smoke else 4
-    window_s = 2.0 if smoke else 8.0
-    total_s = 4.0 if smoke else 14.0
+    window_s = 2.0 if smoke else (4.0 if micro else 8.0)
+    total_s = 4.0 if smoke else (7.0 if micro else 14.0)
     consume = jax.jit(lambda x: x.astype(jnp.int32).sum())
-    host = np.random.randint(0, 255, (chunk_mb << 20,), dtype=np.uint8)
+    rng = np.random.RandomState(12345)
+    pool = [
+        rng.randint(0, 255, (chunk_mb << 20,), dtype=np.uint8)
+        for _ in range(2 if smoke else 8)
+    ]
+    counter = [0]
 
     def put_once():
+        host = pool[counter[0] % len(pool)]
+        # Mutate the WHOLE chunk in place (~sub-ms for 4MB) by adding an
+        # odd constant (mod 256): each entry's content only recurs after
+        # 256 reuses (= pool_size * 256 puts = gigabytes), so neither
+        # whole-buffer nor block-granular content caches can serve it.
+        host += np.uint8(167)
+        counter[0] += 1
         a = jax.device_put(host, dev)
         jax.block_until_ready(consume(a))
 
@@ -305,9 +324,16 @@ def bench_inception(args) -> dict:
     model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
 
     rng = np.random.RandomState(0)
-    base = [rng.randint(0, 256, (299, 299, 3)).astype(np.uint8) for _ in range(batch)]
+    # EVERY record carries unique bytes.  Recycling `batch` base images
+    # made consecutive batches byte-identical on the wire, and the
+    # tunnel serves repeated identical transfers anomalously fast
+    # (content dedup — measured: 181 rec/s "through" a 40 rec/s wire
+    # ceiling, 2026-07-30); the pool is read-only so TensorValue shares
+    # the rows instead of copying ~550MB.
+    pool = rng.randint(0, 256, (records_n, 299, 299, 3), dtype=np.uint8)
+    pool.setflags(write=False)
     records = [
-        TensorValue({"image": base[i % batch]}, {"id": i}) for i in range(records_n)
+        TensorValue({"image": pool[i]}, {"id": i}) for i in range(records_n)
     ]
 
     def make_infer():
@@ -320,6 +346,14 @@ def bench_inception(args) -> dict:
             outputs=("label", "score"),
             transfer_lanes=args.lanes,
         )
+
+    # Pre-pass wire probe: one side of the ceiling BRACKET (VERDICT r3
+    # weak #2 — a single post-run reading of a transport that swings
+    # minute-to-minute cannot bound the pass it surrounds).  Micro-sized
+    # so it costs seconds of token budget, and it leaves the bucket in
+    # the drained state the sustained figure assumes.
+    dev = jax.devices()[0]
+    wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
 
     env = StreamExecutionEnvironment(parallelism=1)
     sink, results, arrivals = _timed_sink()
@@ -380,6 +414,16 @@ def bench_inception(args) -> dict:
     wire_ceiling_rps = (
         wire["sustained_mb_s"] * 1e6 / record_bytes if record_bytes else float("nan")
     )
+    # The BRACKET: the pipeline ran between the pre and post probes, so
+    # its true transport ceiling lies somewhere in [lo, hi] — efficiency
+    # is computed against hi (conservative: cannot exceed 1.0 unless the
+    # transport genuinely changed state mid-pass, which gets an explicit
+    # drift annotation instead of a silent >1 "efficiency").
+    pre_ceiling_rps = (
+        wire_pre["sustained_mb_s"] * 1e6 / record_bytes
+        if record_bytes else float("nan")
+    )
+    ceiling_lo, ceiling_hi = sorted([pre_ceiling_rps, wire_ceiling_rps])
     # A capped/degenerate probe is a BOUND, not a measurement — the
     # projection fields below must not present it as one.
     compute_valid = not compute.get("probe_invalid_capped_to_peak")
@@ -416,26 +460,56 @@ def bench_inception(args) -> dict:
             ),
             "fixed_call_roundtrip_s": round(rtt_s, 5),
         },
-        # Directly measured transport rate (same session, post-run).
+        # Directly measured transport rate, POST-pass (the pre-pass side
+        # of the bracket is wire_pre).
         "wire": {
             **wire,
             "record_bytes": int(record_bytes),
             "wire_ceiling_records_per_sec": round(wire_ceiling_rps, 1),
         },
+        "wire_pre": {
+            **wire_pre,
+            "wire_ceiling_records_per_sec": round(pre_ceiling_rps, 1),
+        },
+        # The pipeline's transport ceiling, bracketed by the pre/post
+        # probes (VERDICT r3 weak #2): the true per-pass ceiling lies in
+        # this range; a single probe of a transport whose sustained rate
+        # swings 3-22 MB/s cannot bound the pass on its own.
+        "wire_ceiling_records_per_sec_range": [
+            round(ceiling_lo, 1), round(ceiling_hi, 1)],
         # On-device forward rate from a resident fori-loop, with MFU.
         "device_compute": compute,
         "bottleneck": (
             "unknown (device-compute probe invalid)" if not compute_rps
             else "host->device wire bandwidth of the tunnel-attached device"
-            if wire_ceiling_rps < 0.7 * compute_rps
+            if ceiling_hi < 0.7 * compute_rps
             else "device compute"
         ),
         # Fraction of the transport's own measured ceiling the full
         # pipeline achieves — the framework-overhead number (1.0 means
-        # every sustained wire byte became a scored record).
+        # every sustained wire byte became a scored record).  Computed
+        # against the UPPER bracket, so a value > 1.0 is impossible
+        # unless the transport changed state mid-pass — which is then
+        # declared in ceiling_drift instead of masquerading as >100%
+        # efficiency.
         "pipeline_efficiency_vs_wire_ceiling": (
-            round(rps_per_chip / wire_ceiling_rps, 3)
-            if wire_ceiling_rps == wire_ceiling_rps and wire_ceiling_rps > 0
+            round(rps_per_chip / ceiling_hi, 3)
+            if ceiling_hi == ceiling_hi and ceiling_hi > 0
+            else None
+        ),
+        "pipeline_efficiency_range": (
+            [round(rps_per_chip / ceiling_hi, 3),
+             round(rps_per_chip / ceiling_lo, 3)]
+            if ceiling_lo == ceiling_lo and ceiling_lo > 0
+            else None
+        ),
+        "ceiling_drift": (
+            "measured pipeline rate exceeds BOTH bracketing wire probes: "
+            "the transport changed state mid-pass (token-bucket refill "
+            "or upstream content caching) — efficiency is unreliable "
+            "for this run"
+            if (ceiling_hi == ceiling_hi and ceiling_hi > 0
+                and rps_per_chip > 1.05 * ceiling_hi)
             else None
         ),
         # Host-attached-chip projection derives from the MEASURED
@@ -476,54 +550,89 @@ def bench_inception(args) -> dict:
 
         ladder = BucketLadder.up_to(ol_batch)
 
-        def make_service():
+        # pipeline_depth 3, NOT the closed-loop default (2*lanes=12):
+        # the paced pass sits at the depth limit whenever a transient
+        # backlog forms (service ~= offered), and every batch then
+        # waits depth * batch_time — measured 2.0s ready_wait at
+        # depth 12.  A shallow pipe forces transient backlogs into
+        # the window operator instead, where the trigger responds
+        # with LARGER windows (better amortization) and recovers.
+        ol_depth = 3
+
+        def make_service(**kw):
             return ModelWindowFunction(
                 model,
                 policy=BucketPolicy(batch=ladder),
                 warmup_batches=tuple(ladder.sizes),
                 outputs=("label", "score"),
                 transfer_lanes=args.lanes,
+                pipeline_depth=ol_depth,
+                **kw,
             )
 
-        # --- calibration: capacity AT the service batch size ----------
-        # The 128-batch closed-loop rec/s overstates what a 16-row
-        # service pipeline sustains (per-window overhead + padding), and
-        # the tunnel's bandwidth drifts between runs — offering 70% of a
-        # stale, oversized capacity melts the queue down.  Calibrate with
-        # a short closed-loop burst through the SAME operator shape,
-        # immediately before the paced pass (this also pre-warms the
-        # service bucket's executable, persistently cached).
-        # The window count must comfortably exceed the dispatch pipeline
-        # depth (2 * lanes): with fewer windows everything is in flight
-        # at once and the arrivals are a flush burst, not a rate
-        # (measured: 8 windows vs depth 12 "calibrated" 288k rec/s).
+        # --- calibration: capacity AT the window size the trigger will
+        # actually fire ------------------------------------------------
+        # At sub-saturation rates the adaptive trigger fires ~1-gap
+        # windows of ~2 records, NOT the 16-bucket: per-call overhead
+        # (tunnel RTT per dispatch) makes small-window capacity a
+        # FRACTION of the 16-window rate, so calibrating at 16 and
+        # offering half of that can still exceed what 2-record windows
+        # sustain (measured: offered 17.8 rps against a 37.6 rps
+        # 16-window calibration collapsed the queue; the 2-window
+        # pipeline sustains far less).  Calibrate with the window size
+        # the paced pass will fire; warmup still pre-compiles the whole
+        # ladder (persistently cached).
+        cal_window = min(2, ol_batch)
         cal_windows = max(4 * 2 * args.lanes, 24)
-        cal_n = min(len(records), cal_windows * ol_batch)
+        cal_n = min(len(records), cal_windows * cal_window)
         env_cal = StreamExecutionEnvironment(parallelism=1)
         cal_sink, cal_results, cal_arrivals = _timed_sink()
         (
             env_cal.from_collection(records[:cal_n], parallelism=1)
-            .count_window(ol_batch, timeout_s=5.0)
+            .count_window(cal_window, timeout_s=5.0)
             .apply(make_service(), name="inception_cal")
             .sink_to_callable(cal_sink)
         )
         env_cal.execute("bench-inception-service-cal", timeout=7200)
         # Exclude the end-of-input flush burst (the last pipeline-depth
-        # windows complete together and inflate the rate).
-        depth_records = 2 * args.lanes * ol_batch
+        # windows complete together and inflate the rate) — sized to the
+        # service operator's ACTUAL depth, not the closed-loop default.
+        depth_records = ol_depth * cal_window
         cut = min(len(cal_arrivals),
-                  max(2 * ol_batch, len(cal_arrivals) - depth_records))
+                  max(2 * cal_window, len(cal_arrivals) - depth_records))
         span = cal_arrivals[cut - 1] - cal_arrivals[0]
-        service_rps = (cut - ol_batch) / span if span > 0 else float("nan")
+        service_rps = (cut - cal_window) / span if span > 0 else float("nan")
         # The calibration burst can ride the tunnel's token bucket and
-        # overstate sustainable capacity; the wire probe's sustained rate
-        # is the binding constraint — offer rate_fraction of the SMALLER
-        # (an offered rate above the wire ceiling measures the transport
-        # backlog, not the framework's service latency).
+        # overstate sustainable capacity, and the post-closed-loop probe
+        # is minutes stale by now — re-probe the wire HERE (calibration
+        # just drained the bucket, so this reads the true current
+        # sustained rate) and offer rate_fraction of the smallest of
+        # service capacity and both wire readings (an offered rate above
+        # the wire ceiling measures the transport backlog, not the
+        # framework's service latency).
+        wire_pre_ol = _wire_probe(dev, smoke=args.smoke, micro=True)
+        preol_ceiling_rps = (
+            wire_pre_ol["sustained_mb_s"] * 1e6 / record_bytes
+            if record_bytes else float("nan")
+        )
         capacity_rps = service_rps
-        if wire_ceiling_rps == wire_ceiling_rps:  # not NaN
-            capacity_rps = min(service_rps, wire_ceiling_rps)
+        for cap in (wire_ceiling_rps, preol_ceiling_rps):
+            if cap == cap:  # not NaN
+                capacity_rps = min(capacity_rps, cap)
         rate = max(args.rate_fraction * capacity_rps, 1.0)
+        # --- measured latency floor (VERDICT r3 #1) -------------------
+        # The physics this transport permits for ONE record fired
+        # immediately: its own bytes over the sustained wire + the fixed
+        # call round trip + one poll interval of result collection.
+        # Everything the framework adds on top of this is attributable
+        # overhead; a budget below it is infeasible BY MEASUREMENT, so
+        # the effective budget auto-raises above the floor.
+        idle_flush_s = args.open_loop_idle_flush_s
+        ol_wire_mb_s = wire_pre_ol["sustained_mb_s"] or wire["sustained_mb_s"]
+        one_record_wire_s = (
+            record_bytes / (ol_wire_mb_s * 1e6) if ol_wire_mb_s else 0.0
+        )
+        floor_s = rtt_s + one_record_wire_s + idle_flush_s
         # Hard latency budget for the adaptive trigger (VERDICT r2 #2).
         # This is a latency GOAL, independent of the batch fill time: a
         # budget >= fill time makes the projection conclude "will fill"
@@ -531,20 +640,28 @@ def bench_inception(args) -> dict:
         # 1.0s vs fill 1.02s -> p50 1.31s).  With a 0.3s goal the EWMA
         # policy flushes partial windows at the arrival cadence and p50
         # lands near one inter-arrival gap + small-batch service time.
-        budget_s = (
+        # The trigger additionally reserves the observed service time
+        # out of the budget (AdaptiveLatencyTrigger.observe_service_time).
+        requested_budget_s = (
             args.open_loop_timeout_s if args.open_loop_timeout_s is not None
             else 0.3
         )
+        budget_s = max(requested_budget_s, 1.5 * floor_s)
 
         from flink_tensorflow_tpu.io import PacedSource
 
         env2 = StreamExecutionEnvironment(parallelism=1)
-        samples = []  # (scheduled arrival, measured latency)
+        samples = []  # (scheduled arrival, latency, stage stamps or None)
 
         def ol_sink(record):
             sched = record.meta.get("sched_ts")
             if sched is not None:
-                samples.append((sched, time.monotonic() - sched))
+                st = record.meta.get("__stages__")
+                if st is not None and "__arrive_ts__" in record.meta:
+                    # Stamped by the window operator at ingestion; splits
+                    # upstream queueing from the trigger's own hold.
+                    st = {**st, "arrive_ts": record.meta["__arrive_ts__"]}
+                samples.append((sched, time.monotonic() - sched, st))
 
         # Delay the schedule past the pipeline's open(); the service
         # bucket's executable is already in the persistent cache from
@@ -558,31 +675,78 @@ def bench_inception(args) -> dict:
             # part 3): fire early when the EWMA arrival-rate projection
             # says the window won't fill inside the budget.
             .count_window(ol_batch, latency_budget_s=budget_s)
-            .apply(make_service(), name="inception_ol")
+            .apply(make_service(idle_flush_s=idle_flush_s,
+                                stamp_stages=True),
+                   name="inception_ol")
             .sink_to_callable(ol_sink)
         )
         env2.execute("bench-inception-open-loop", timeout=7200)
+        # Close the bracket around the open-loop pass: the mid probe
+        # ("wire") ran before calibration, this one right after the
+        # paced schedule — a saturated verdict below can be checked
+        # against what the transport actually sustained at pass end.
+        wire_after_ol = _wire_probe(dev, smoke=args.smoke, micro=True)
         # Steady-state filter: the source's clock starts while the model
         # operator may still be compiling in open(); records scheduled
         # before the first result emerged carry that one-time warmup in
         # their latency.  Measure only arrivals scheduled after it.
-        first_emit = min(s + l for s, l in samples) if samples else 0.0
-        steady = [l for s, l in samples if s >= first_emit]
+        first_emit = min(s + l for s, l, _ in samples) if samples else 0.0
+        steady = [(s, l, st) for s, l, st in samples if s >= first_emit]
         fallback = not steady
         if fallback:
             # Every record was scheduled before the first result emerged
             # (pipeline warmup outlasted the whole schedule): the numbers
             # below include warmup and must say so.
-            steady = [l for _, l in samples]
-        p50, p99 = _percentiles_ms(steady)
+            steady = list(samples)
+        p50, p99 = _percentiles_ms([l for _, l, _ in steady])
+        # --- per-sample latency decomposition (VERDICT r3 #1) ---------
+        # Every stage boundary is stamped by the runner into the record's
+        # metadata; summed, the stages account for the whole end-to-end
+        # latency — no unexplained residue:
+        #   queue_wait     scheduled arrival -> record reached the window
+        #                  operator (upstream channel/backpressure)
+        #   trigger_hold   operator arrival -> window fire/dispatch
+        #                  (pure trigger policy)
+        #   lane_wait      dispatch call -> a transfer lane picks it up
+        #   h2d_dispatch   assemble + host->device wire + launch
+        #   ready_wait     launched -> the poll loop starts the fetch
+        #                  (device compute overlaps here; ~2ms/16-batch
+        #                  per the compute probe, so this is wire+poll)
+        #   fetch          device->host result transfer (tunnel RTT-bound)
+        #   emit           fetch done -> sink observed it
+        stage_vals = {k: [] for k in (
+            "queue_wait", "trigger_hold", "lane_wait", "h2d_dispatch",
+            "ready_wait", "fetch", "emit")}
+        for s, l, st in steady:
+            if not st:
+                continue
+            arrive = st.get("arrive_ts", s)
+            stage_vals["queue_wait"].append(arrive - s)
+            stage_vals["trigger_hold"].append(st["t0"] - arrive)
+            # lane_wait includes coerce+assemble (they run on the lane
+            # thread before launch); h2d_dispatch is the launch interval
+            # proper — together the boundaries tile t0..t_done exactly.
+            stage_vals["lane_wait"].append(st["lane_wait_s"])
+            stage_vals["h2d_dispatch"].append(
+                st["t_dispatched"] - st["t_lane_start"])
+            stage_vals["ready_wait"].append(
+                st["t_fetch_start"] - st["t_dispatched"])
+            stage_vals["fetch"].append(st["t_done"] - st["t_fetch_start"])
+            stage_vals["emit"].append((s + l) - st["t_done"])
+        decomposition = {}
+        for k, vals in stage_vals.items():
+            if vals:
+                sp50, sp99 = _percentiles_ms(vals)
+                decomposition[k] = {"p50_ms": sp50, "p99_ms": sp99}
         # Achieved service rate over the emission span: when the tunnel's
         # bandwidth drops below the offered load mid-pass (its token-
         # bucket swings 3-22 MB/s), the queue grows and p50 measures the
         # TRANSPORT's shortfall — the saturated flag says so explicitly.
-        emits = sorted(s + l for s, l in samples)
+        emits = sorted(s + l for s, l, _ in samples)
         span = emits[-1] - emits[0] if len(emits) > 1 else float("nan")
         achieved = (len(emits) - 1) / span if span > 0 else float("nan")
         saturated = bool(achieved < 0.9 * rate) if achieved == achieved else True
+        floor_ms = floor_s * 1e3
         out["open_loop"] = {
             "arrival_process": "poisson",
             "offered_rate_rps": round(rate, 2),
@@ -590,8 +754,23 @@ def bench_inception(args) -> dict:
             "service_capacity_rps": round(service_rps, 2),
             "capacity_cap_rps": round(capacity_rps, 2),
             "service_batch": ol_batch,
-            "trigger": "adaptive_latency_ewma",
+            "trigger": "adaptive_latency_ewma+service_reserve",
+            "result_collection": f"ready-poll every {idle_flush_s*1e3:.0f}ms",
+            "latency_budget_requested_ms": round(requested_budget_s * 1e3, 1),
+            # Effective budget: auto-raised to 1.5x the measured floor
+            # when the requested budget is infeasible on this transport.
             "latency_budget_ms": round(budget_s * 1e3, 1),
+            "budget_auto_raised": bool(budget_s > requested_budget_s),
+            # The measured floor: RTT + one record's bytes over the
+            # sustained wire + one collection-poll interval.  No
+            # configuration of this framework (or any other) beats it on
+            # this transport.
+            "latency_floor_ms": round(floor_ms, 1),
+            "floor_components_ms": {
+                "fixed_call_roundtrip": round(rtt_s * 1e3, 1),
+                "one_record_wire": round(one_record_wire_s * 1e3, 1),
+                "collection_poll": round(idle_flush_s * 1e3, 1),
+            },
             "records": ol_n,
             "steady_state_samples": len(steady),
             "warmup_contaminated": fallback,
@@ -600,8 +779,21 @@ def bench_inception(args) -> dict:
             # (latency then measures the tunnel's backlog, not the
             # framework's service time).
             "saturated": saturated,
+            # The wire bracket for THIS pass: "before" ran right after
+            # calibration (it set the capacity cap and the floor),
+            # "after" right after the paced schedule.  An offered_mb_s
+            # above the after-reading explains a saturated=true verdict
+            # as mid-pass transport drift.
+            "wire_sustained_mb_s_bracket": [
+                wire_pre_ol["sustained_mb_s"],
+                wire_after_ol["sustained_mb_s"]],
+            "offered_mb_s": round(rate * record_bytes / 1e6, 2),
             "p50_latency_ms": p50,
             "p99_latency_ms": p99,
+            "p50_over_floor": (
+                round(p50 / floor_ms, 2) if floor_ms else None),
+            "budget_met": bool(p50 == p50 and p50 <= budget_s * 1e3),
+            "per_sample_decomposition_ms": decomposition,
         }
     return out
 
@@ -906,6 +1098,10 @@ def main(argv=None):
     p.add_argument("--open-loop-timeout-s", type=float, default=None,
                    help="count-or-timeout window timeout for the open-loop "
                         "pass (default: sized for ~16 records/window)")
+    p.add_argument("--open-loop-idle-flush-s", type=float, default=0.015,
+                   help="ready-poll interval for open-loop result "
+                        "collection (non-blocking; bounds the time a "
+                        "device-complete result waits for emission)")
     p.add_argument("--open-loop-start-delay-s", type=float, default=60.0,
                    help="shift the open-loop schedule past pipeline warmup "
                         "(covers one cold XLA compile of the service bucket)")

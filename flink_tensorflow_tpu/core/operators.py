@@ -507,10 +507,32 @@ class WindowOperator(_FunctionOperator):
         self._buffers: typing.Dict[typing.Any, WindowBuffer] = {}
         self._window_seq: typing.Dict[typing.Any, int] = {}
         self._collector: typing.Optional[fn.Collector] = None
+        self._svc_feed = None       # resolved in open()
+        self._arrival_stamp = False  # resolved in open()
 
     def open(self) -> None:
         self._collector = fn.Collector(self.output.emit)
         super().open()
+        # Budget-targeting triggers reserve the observed service time out
+        # of their latency budget; wire the function's runner EWMA to the
+        # trigger when both sides speak the protocol (resolved once —
+        # this touches the per-record hot path).
+        observe = getattr(self.trigger, "observe_service_time", None)
+        estimate = getattr(self.function, "service_time_estimate", None)
+        self._svc_feed = (
+            (estimate, observe) if observe is not None and estimate is not None
+            else None
+        )
+        # Stage-stamping functions also want each record's ARRIVAL time
+        # at this operator (splits upstream queue-wait from the trigger's
+        # own hold in the latency decomposition).
+        self._arrival_stamp = bool(getattr(self.function, "_stamp_stages", False))
+
+    def _feed_service_time(self) -> None:
+        if self._svc_feed is not None:
+            est = self._svc_feed[0]()
+            if est is not None:
+                self._svc_feed[1](est)
 
     def _key_of(self, value):
         return self.key_selector(value) if self.key_selector is not None else self.GLOBAL_KEY
@@ -525,6 +547,10 @@ class WindowOperator(_FunctionOperator):
             buf = WindowBuffer(window=CountWindow(seq))
             self._buffers[key] = buf
         value = record.value
+        if self._arrival_stamp:
+            m = getattr(value, "meta", None)
+            if isinstance(m, dict):
+                m["__arrive_ts__"] = time.monotonic()
         # Zero-copy ingestion: tensor window functions may take the record
         # payload NOW (into their ring arena) and buffer only a token —
         # non-keyed only, and never for retaining (sliding) triggers:
@@ -536,6 +562,7 @@ class WindowOperator(_FunctionOperator):
             if token is not None:
                 value = token
         buf.add(value, record.timestamp)
+        self._feed_service_time()
         if self.trigger.on_element(buf):
             self._fire(key, buf)
 
@@ -574,6 +601,7 @@ class WindowOperator(_FunctionOperator):
         return min(deadlines) if deadlines else None
 
     def fire_due(self, now):
+        self._feed_service_time()
         due = [
             key
             for key, buf in self._buffers.items()

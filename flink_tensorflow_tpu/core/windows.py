@@ -127,6 +127,15 @@ class AdaptiveLatencyTrigger(Trigger):
       after the last arrival (a Nagle-style grace so micro-bursts still
       coalesce), never later than the hard budget.
 
+    **Service-time reserve (r4):** the budget is END-TO-END — arrival to
+    emitted result — but the trigger only controls the hold.  When the
+    operator feeds back an observed per-batch service time
+    (``observe_service_time``, wired by WindowOperator from the model
+    function's runner EWMA), the fire deadline is pulled forward so that
+    ``hold + service <= budget``: a window stops waiting out its Nagle
+    grace the moment the remaining budget is needed for the device round
+    trip.  Without feedback the behavior is unchanged.
+
     At 0.5x capacity this puts p50 near one inter-arrival gap plus the
     small-batch service time instead of near the budget — the static
     ``CountOrTimeoutTrigger`` parks every record at the timeout
@@ -150,10 +159,17 @@ class AdaptiveLatencyTrigger(Trigger):
         self.ewma_alpha = ewma_alpha
         self._gap_ewma: typing.Optional[float] = None
         self._last_arrival: typing.Optional[float] = None
+        self._service_ewma: typing.Optional[float] = None
 
     def clone(self) -> "AdaptiveLatencyTrigger":
         return AdaptiveLatencyTrigger(
             self.count, self.latency_budget_s, ewma_alpha=self.ewma_alpha)
+
+    def observe_service_time(self, service_s: float) -> None:
+        """Feed the observed per-batch service time (dispatch -> result).
+        The deadline reserves it out of the budget so holds never spend
+        budget the round trip needs."""
+        self._service_ewma = service_s
 
     def on_element(self, window_state):
         now = time.monotonic()
@@ -181,7 +197,21 @@ class AdaptiveLatencyTrigger(Trigger):
         if projected_fill <= hard:
             return hard  # on track to fill: let the count fire
         # Won't fill in budget: flush after one expected gap of quiet.
-        return min(hard, self._last_arrival + self._gap_ewma)
+        d = min(hard, self._last_arrival + self._gap_ewma)
+        if self._service_ewma is not None:
+            # Reserve the device round trip out of the END-TO-END budget:
+            # the latest on-time fire is ``hard - service``.  Clamped to
+            # one expected gap after the FIRST arrival — firing earlier
+            # collapses the window to a single record, and the per-call
+            # overhead of 1-record dispatches can sink below the offered
+            # rate (measured: service-reserve without this clamp drove
+            # batch-1 fires whose ~RTT-per-call capacity was HALF the
+            # offered rate — a queueing collapse with p50 in seconds,
+            # strictly worse than the latency the reserve was saving).
+            reserved = hard - self._service_ewma
+            d = min(d, max(reserved,
+                           window_state.first_element_time + self._gap_ewma))
+        return d
 
 
 class SlidingCountTrigger(Trigger):

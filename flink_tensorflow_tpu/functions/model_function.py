@@ -65,6 +65,7 @@ class _ModelFunctionBase(fn.RichFunction):
         donate_inputs: bool = False,
         outputs: typing.Optional[typing.Sequence[str]] = None,
         transfer_lanes: int = 1,
+        stamp_stages: bool = False,
     ):
         self._source = model
         self._method_name = method
@@ -74,8 +75,17 @@ class _ModelFunctionBase(fn.RichFunction):
         self._donate = donate_inputs
         self._outputs = outputs
         self._transfer_lanes = transfer_lanes
+        #: Stamp per-record stage timestamps into result metadata
+        #: (``meta["__stages__"]``) for latency decomposition.
+        self._stamp_stages = stamp_stages
         self.runner: typing.Optional[CompiledMethodRunner] = None
         self._out: typing.Optional[fn.Collector] = None
+
+    def service_time_estimate(self) -> typing.Optional[float]:
+        """EWMA of the per-batch service time (dispatch -> results on
+        host).  Budget-targeting triggers reserve this out of their
+        latency budget (WindowOperator feeds it to the trigger)."""
+        return self.runner.service_ewma_s if self.runner is not None else None
 
     def clone(self) -> "fn.Function":
         # Subtasks share the host-side source (read-only); each builds its
@@ -98,6 +108,7 @@ class _ModelFunctionBase(fn.RichFunction):
             output_names=self._outputs,
             dispatch_lanes=self._transfer_lanes,
         )
+        self.runner.stamp_stages = self._stamp_stages
         self.runner.open(ctx)
         if self._warmup:
             self.runner.warmup(self._warmup, self._warmup_length_bucket)
@@ -263,6 +274,7 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
         self._max_in_flight = pipeline_depth - 1
         self._idle_flush_s = idle_flush_s
         self._last_dispatch: typing.Optional[float] = None
+        self._last_poll: typing.Optional[float] = None
         self._use_ring = use_ring
         self._ring_capacity = ring_capacity
         self._ring = None
@@ -439,19 +451,51 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
             for record in self.runner.collect_ready(self._max_in_flight):
                 out.collect(record)
 
-    # Timer hooks (WindowOperator.next_deadline/fire_due): if the stream
-    # goes quiet with batches in flight, flush them after idle_flush_s —
-    # pipelining must not defeat the timeout trigger's latency bound.
+    # Timer hooks (WindowOperator.next_deadline/fire_due): while batches
+    # are in flight, poll every idle_flush_s and emit whatever is READY —
+    # without blocking the subtask thread.  The pre-r4 behavior (a full
+    # blocking flush idle_flush_s after the last dispatch) turned the
+    # operator into an M/D/1 server at open-loop rates: every window's
+    # results waited out the whole device round trip on the subtask
+    # thread while later windows queued behind it (BENCH_r03's 536ms p50
+    # at 0.5x capacity).  Polling emits each batch within one poll
+    # interval of its results landing, and the thread stays free to
+    # accept arrivals and fire the next window meanwhile.
     def next_deadline(self) -> typing.Optional[float]:
         if self.runner is None or not self.runner._pending or self._last_dispatch is None:
             return None
-        return self._last_dispatch + self._idle_flush_s
+        base = self._last_dispatch
+        if self._last_poll is not None and self._last_poll > base:
+            base = self._last_poll
+        return base + self._idle_flush_s
 
     def fire_due(self, now: float) -> None:
         d = self.next_deadline()
-        if d is not None and now >= d and self._out is not None:
-            for record in self.runner.flush():
-                self._out.collect(record)
+        if d is None or now < d or self._out is None:
+            return
+        for record in self.runner.collect_available():
+            self._out.collect(record)
+        self._last_poll = now
+        # Stall fallback: if the oldest batch has been pending for far
+        # longer than the observed service time (a backend whose
+        # is_ready never reports, or a wedged transfer), fall back to
+        # ONE blocking fetch so results cannot strand forever.  The
+        # threshold rides the service EWMA so legitimately slow batches
+        # (multi-second wire transfers at large buckets) never trip it;
+        # before ANY observation exists (warmup resets the EWMA) the
+        # guard is a generous constant — the first post-warmup batch on
+        # a slow transport can legitimately take seconds, and tripping
+        # on it would reintroduce the blocking fetch this path removes.
+        age = self.runner.oldest_pending_age_s(now)
+        if age is not None:
+            svc = self.runner.service_ewma_s
+            stall_s = max(30.0 if svc is None else 1.0,
+                          10.0 * self._idle_flush_s,
+                          4.0 * svc if svc is not None else 0.0)
+            if age > stall_s:
+                for record in self.runner.collect_ready(
+                        len(self.runner._pending) - 1):
+                    self._out.collect(record)
 
     def on_finish(self, out: fn.Collector):
         for record in self.runner.flush():

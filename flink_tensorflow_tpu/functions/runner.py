@@ -75,7 +75,20 @@ class CompiledMethodRunner:
         self._metrics = None
         #: In-flight dispatched batches: (batch, output futures, t0).
         self._pending: collections.deque = collections.deque()
+        #: Dispatch timestamps of in-flight batches (same order as
+        #: ``_pending``) — lets callers age the oldest batch without
+        #: touching lane futures.
+        self._pending_t0: collections.deque = collections.deque()
         self._batch_seq = 0
+        #: Stamp per-record stage timestamps into result metadata
+        #: (``meta["__stages__"]``) — the open-loop bench's per-sample
+        #: latency decomposition (VERDICT r3 #1).  Off by default: the
+        #: stamps cost a dict per record on the hot path.
+        self.stamp_stages = False
+        #: EWMA of dispatch-call -> results-fetched seconds per batch.
+        #: Fed to latency-budget triggers (AdaptiveLatencyTrigger
+        #: reserves this much of the budget for service).
+        self.service_ewma_s: typing.Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, ctx: typing.Optional["RuntimeContext"] = None) -> None:
@@ -129,7 +142,9 @@ class CompiledMethodRunner:
         shapes = schema.resolve_dynamic(length_bucket)
         # Warmup batches pay the XLA compile inside the dispatch interval;
         # keep them out of the steady-state metrics (dispatch_s would
-        # otherwise report compile time as wire-transfer time).
+        # otherwise report compile time as wire-transfer time) AND out of
+        # the service-time EWMA (a compile-contaminated estimate would
+        # make the latency-budget trigger reserve seconds it never needs).
         metrics, self._metrics = self._metrics, None
         try:
             for b in batch_sizes:
@@ -137,6 +152,7 @@ class CompiledMethodRunner:
                 self.run_batch([TensorValue(fields)] * b)
         finally:
             self._metrics = metrics
+            self.service_ewma_s = None
 
     def close(self) -> None:
         # Block on dispatched work before dropping it: the executables may
@@ -157,6 +173,7 @@ class CompiledMethodRunner:
                     on_done()
             except Exception:  # noqa: BLE001 - cancellation teardown
                 pass
+        self._pending_t0.clear()
         if self._pool is not None:
             self._pool.shutdown(wait=True, cancel_futures=True)
             self._pool = None
@@ -179,6 +196,7 @@ class CompiledMethodRunner:
         t0 = time.monotonic()
         self._batch_seq += 1
         seq = self._batch_seq
+        self._pending_t0.append(t0)
         if self._pool is not None:
             self._pending.append(self._pool.submit(self._dispatch_work, list(records), t0, seq))
         else:
@@ -200,6 +218,7 @@ class CompiledMethodRunner:
         t0 = time.monotonic()
         self._batch_seq += 1
         seq = self._batch_seq
+        self._pending_t0.append(t0)
         if self._pool is not None:
             self._pending.append(self._pool.submit(
                 self._launch_batch, batch, t0, seq, assemble_s, on_done))
@@ -235,20 +254,57 @@ class CompiledMethodRunner:
             # the jitted-call dispatch, so this interval IS the transfer cost.
             "dispatch_s": t_c - t_b,
             "h2d_bytes": sum(a.nbytes for a in batch.arrays.values()),
+            # Stage boundaries for the per-sample latency decomposition:
+            # t0 -> t_lane_start is lane-pool queueing, t_lane_start ->
+            # t_dispatched is assemble + h2d transfer + launch.
+            "t_lane_start": t_b,
+            "t_dispatched": t_c,
         }
         return batch, outputs, timings, on_done
 
     def _fetch_oldest(self) -> typing.List[TensorValue]:
+        t_fetch_start = time.monotonic()
         item = self._pending.popleft()
+        self._pending_t0.popleft()
         if isinstance(item, concurrent.futures.Future):
             item = item.result()  # re-raises lane-thread failures here
         batch, outputs, timings, on_done = item
         host = DeviceTransfer.fetch(outputs)  # blocks on this batch only
+        t_done = time.monotonic()
         results = batch.unbatch(host)
         if on_done is not None:
             on_done()
+        dt = t_done - timings["t0"]
+        # Per-batch service time (dispatch call -> results on host): the
+        # latency-budget trigger reserves this out of its budget.
+        self.service_ewma_s = (
+            dt if self.service_ewma_s is None
+            else 0.75 * self.service_ewma_s + 0.25 * dt
+        )
+        if self.stamp_stages:
+            stages = {
+                "t0": timings["t0"],
+                # lane_wait INCLUDES coerce+assemble on the dispatch()
+                # path (both run on the lane thread before launch);
+                # assemble_s is its sub-component, t_lane_start the
+                # boundary — so the stage intervals t0 -> t_lane_start ->
+                # t_dispatched -> t_fetch_start -> t_done tile the batch
+                # lifetime exactly (no overlap, no gap).
+                "lane_wait_s": timings["t_lane_start"] - timings["t0"],
+                "assemble_s": timings["assemble_s"],
+                "dispatch_s": timings["dispatch_s"],
+                "t_lane_start": timings["t_lane_start"],
+                "t_dispatched": timings["t_dispatched"],
+                "t_fetch_start": t_fetch_start,
+                "t_done": t_done,
+                "batch_n": len(results),
+            }
+            for r in results:
+                # Each result's meta dict is its own copy (unbatch
+                # rebuilds TensorValues), so stamping cannot leak into
+                # the input records.
+                r.meta["__stages__"] = stages
         if self._metrics is not None:
-            dt = time.monotonic() - timings["t0"]
             self._metrics.meter("records").mark(len(results))
             self._metrics.histogram("batch_latency_s").record(dt)
             self._metrics.histogram("record_latency_s").record(dt / max(1, len(results)))
@@ -265,6 +321,51 @@ class CompiledMethodRunner:
         while len(self._pending) > max_in_flight:
             out.extend(self._fetch_oldest())
         return out
+
+    def _oldest_available(self) -> bool:
+        """True when the oldest in-flight batch can be fetched WITHOUT
+        blocking: its lane work is done and every output buffer reports
+        ready.  A lane failure also returns True — the exception must
+        surface through ``_fetch_oldest``, not hide behind readiness."""
+        if not self._pending:
+            return False
+        item = self._pending[0]
+        if isinstance(item, concurrent.futures.Future):
+            if not item.done():
+                return False
+            try:
+                resolved = item.result()
+            except BaseException:
+                return True  # _fetch_oldest re-raises it
+            self._pending[0] = resolved
+            item = resolved
+        import jax
+
+        _, outputs, _, _ = item
+        return all(
+            x.is_ready() for x in jax.tree.leaves(outputs)
+            if hasattr(x, "is_ready")
+        )
+
+    def collect_available(self) -> typing.List[TensorValue]:
+        """Fetch every batch whose results are ALREADY on/leaving the
+        device — never blocks on in-flight compute or transfer.  This is
+        the open-loop latency lever: a poll loop emits results the moment
+        they are ready instead of parking the subtask thread in a full
+        ``flush`` for the whole device round trip (which turns the
+        operator into a blocking M/D/1 server and queues every later
+        window behind the wire — BENCH_r03's unexplained 536ms p50)."""
+        out: typing.List[TensorValue] = []
+        while self._oldest_available():
+            out.extend(self._fetch_oldest())
+        return out
+
+    def oldest_pending_age_s(self, now: typing.Optional[float] = None) -> typing.Optional[float]:
+        """Seconds since the oldest in-flight batch was dispatched, or
+        None when nothing is pending (stall-detection hook)."""
+        if not self._pending_t0:
+            return None
+        return (now if now is not None else time.monotonic()) - self._pending_t0[0]
 
     def flush(self) -> typing.List[TensorValue]:
         """Block for every in-flight batch (end of input / pre-snapshot)."""

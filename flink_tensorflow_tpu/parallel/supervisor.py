@@ -35,6 +35,10 @@ class CohortOutcome:
 
     attempts: int  # total spawn rounds used (1 = no failures)
     returncode: int  # 0 on success
+    #: Worker count of the SUCCESSFUL attempt — smaller than the initial
+    #: count when elastic recovery re-formed the cohort after permanent
+    #: worker loss.
+    num_workers: int = 0
 
 
 class CohortFailed(RuntimeError):
@@ -60,6 +64,18 @@ class CohortSupervisor:
     the cohort is re-spawned, up to ``max_restarts`` times.  Workers are
     responsible for restoring their state from the latest common
     checkpoint on re-spawn (restart-from-checkpoint, not live elasticity).
+
+    **Elastic recovery** (``elastic=True``): exhausting the respawn
+    budget at one cohort shape is treated as PERMANENT worker loss (the
+    reference's region-failover analogue needs no operator in the loop —
+    SURVEY.md §5 "Failure detection / elastic recovery"), and instead of
+    giving up the supervisor re-forms the cohort one worker smaller —
+    down to ``min_workers`` — with a fresh respawn budget per shape.
+    The command builder receives the CURRENT ``num_workers``, and the
+    workers' cohort-rescaling restore (shard merge + key-group
+    redistribution, validated against the participant set each shard
+    recorded) carries the state across the shape change; no human
+    relaunch, no state loss.
     """
 
     def __init__(
@@ -72,9 +88,15 @@ class CohortSupervisor:
         poll_s: float = 0.1,
         kill_grace_s: float = 5.0,
         attempt_timeout_s: typing.Optional[float] = None,
+        elastic: bool = False,
+        min_workers: int = 1,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
+        if not 1 <= min_workers <= num_workers:
+            raise ValueError(
+                f"min_workers must be in [1, {num_workers}], got {min_workers}"
+            )
         self.command = command
         self.num_workers = num_workers
         self.env = env
@@ -82,22 +104,24 @@ class CohortSupervisor:
         self.poll_s = poll_s
         self.kill_grace_s = kill_grace_s
         self.attempt_timeout_s = attempt_timeout_s
+        self.elastic = elastic
+        self.min_workers = min_workers
 
     # -- one attempt -------------------------------------------------------
-    def _spawn(self, attempt: int) -> typing.List[subprocess.Popen]:
+    def _spawn(self, attempt: int, num_workers: int) -> typing.List[subprocess.Popen]:
         procs = []
         try:
-            for w in range(self.num_workers):
+            for w in range(num_workers):
                 env = dict(os.environ)
                 if self.env is not None:
-                    env.update(self.env(w, self.num_workers, attempt))
+                    env.update(self.env(w, num_workers, attempt))
                 procs.append(
                     subprocess.Popen(
-                        list(self.command(w, self.num_workers, attempt)), env=env
+                        list(self.command(w, num_workers, attempt)), env=env
                     )
                 )
-                logger.info("attempt %d: spawned worker %d (pid %d)", attempt, w,
-                            procs[-1].pid)
+                logger.info("attempt %d: spawned worker %d/%d (pid %d)",
+                            attempt, w, num_workers, procs[-1].pid)
         except BaseException:
             # A failed spawn must not orphan the workers already started —
             # they would block forever waiting for the full cohort.
@@ -119,9 +143,9 @@ class CohortSupervisor:
                     p.kill()
                     p.wait()
 
-    def _run_attempt(self, attempt: int) -> int:
+    def _run_attempt(self, attempt: int, num_workers: int) -> int:
         """Returns 0 on cohort success, else the failing worker's rc."""
-        procs = self._spawn(attempt)
+        procs = self._spawn(attempt, num_workers)
         deadline = (
             time.monotonic() + self.attempt_timeout_s
             if self.attempt_timeout_s is not None else None
@@ -148,12 +172,28 @@ class CohortSupervisor:
     # -- public ------------------------------------------------------------
     def run(self) -> CohortOutcome:
         last_rc = -1
-        for attempt in range(self.max_restarts + 1):
-            rc = self._run_attempt(attempt)
-            if rc == 0:
-                return CohortOutcome(attempts=attempt + 1, returncode=0)
-            last_rc = rc
-        raise CohortFailed(self.max_restarts + 1, last_rc)
+        shape = self.num_workers
+        attempt = 0  # global, monotonic across shapes (port rotation etc.)
+        while True:
+            for _ in range(self.max_restarts + 1):
+                rc = self._run_attempt(attempt, shape)
+                attempt += 1
+                if rc == 0:
+                    return CohortOutcome(attempts=attempt, returncode=0,
+                                         num_workers=shape)
+                last_rc = rc
+            if self.elastic and shape > self.min_workers:
+                # Respawn budget exhausted at this shape: treat it as
+                # permanent worker loss and re-form one smaller with a
+                # fresh budget.  The workers' cohort-rescaling restore
+                # redistributes the lost worker's state by key group.
+                logger.warning(
+                    "respawn budget exhausted at %d workers — re-forming "
+                    "the cohort elastically at %d", shape, shape - 1,
+                )
+                shape -= 1
+                continue
+            raise CohortFailed(attempt, last_rc)
 
 
 def latest_common_checkpoint(

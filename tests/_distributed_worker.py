@@ -65,6 +65,129 @@ class WindowSum(fn.WindowFunction):
         ))
 
 
+class EventWindowSum(fn.WindowFunction):
+    """Event-time window aggregate: emits (key, sum, count, window_start)
+    — the window's event-time start pins window identity across runs."""
+
+    def process_window(self, key, window, elements, out):
+        vals = [int(v["v"]) for v in elements]
+        out.collect(TensorValue(
+            {"s": np.int64(sum(vals))},
+            {"key": int(key), "n": len(vals),
+             "start": round(float(window.start), 3)},
+        ))
+
+
+def event_ts_of(i: int) -> float:
+    """Deterministic event-time schedule with deliberately-late outliers:
+    record i sits at i*0.25s, except every (i%23==7, i>40)-th record,
+    which arrives 9s in the past — far beyond the 0.5s out-of-orderness
+    bound, so it must land in the late side output once the watermark
+    passed its window."""
+    base = i * 0.25
+    if i > 40 and i % 23 == 7:
+        return base - 9.0
+    return base
+
+
+def _event_time_stages(env, args):
+    """Event-time tumbling windows + late side output + session windows,
+    all keyed — key groups (and therefore watermark-driven firing, late
+    routing, and session merging) span the cohort's TCP channels."""
+    import os
+
+    records = [
+        TensorValue({"v": np.int64(i)}, {"i": i, "key": i % NUM_KEYS})
+        for i in range(args.n)
+    ]
+    stamped = (
+        env.from_collection(records, parallelism=1)
+        .assign_timestamps(lambda r: event_ts_of(int(r.meta["i"])),
+                           out_of_orderness_s=0.5, watermark_every=8)
+    )
+    main = (
+        stamped.key_by(lambda r: int(r.meta["key"]))
+        .time_window(2.0)
+        .apply(EventWindowSum(), name="et_window", parallelism=args.par,
+               late_tag="late")
+    )
+    main.add_sink(
+        ExactlyOnceRecordFileSink(os.path.join(args.out, "main")),
+        name="sink_main", parallelism=1)
+    (
+        main.side_output("late")
+        .map(lambda r: TensorValue({"v": r["v"]},
+                                   {"i": int(r.meta["i"]),
+                                    "key": int(r.meta["key"])}),
+             name="late_project", parallelism=1)
+        .add_sink(
+            ExactlyOnceRecordFileSink(os.path.join(args.out, "late")),
+            name="sink_late", parallelism=1)
+    )
+    (
+        stamped.key_by(lambda r: int(r.meta["key"]))
+        .session_window(1.0)
+        .apply(EventWindowSum(), name="et_session", parallelism=args.par)
+        .add_sink(
+            ExactlyOnceRecordFileSink(os.path.join(args.out, "session")),
+            name="sink_session", parallelism=1)
+    )
+
+
+def _interval_join_stages(env, args):
+    """Event-time interval join whose two inputs ORIGINATE on different
+    processes: the left source is a par-1 collection (subtask 0 ->
+    process 0); the right is a par-2 generator emitting only from
+    subtask 1 (-> process 1 in a 2-process cohort), so every joined pair
+    crossed the record plane."""
+    import os
+
+    from flink_tensorflow_tpu.io import GeneratorSource
+
+    n = args.n
+    left = [
+        TensorValue({"v": np.int64(i)}, {"side": "L", "i": i, "key": i % 2})
+        for i in range(n)
+    ]
+    right = [
+        TensorValue({"v": np.int64(100 + j)},
+                    {"side": "R", "i": j, "key": j % 2})
+        for j in range(n)
+    ]
+
+    def right_factory(subtask, parallelism):
+        return iter(right) if subtask == 1 else iter(())
+
+    ls = (
+        env.from_collection(left, parallelism=1)
+        .assign_timestamps(lambda r: int(r.meta["i"]) * 0.5,
+                           watermark_every=4, name="ts_left")
+        .key_by(lambda r: int(r.meta["key"]))
+    )
+    rs = (
+        env.from_source(GeneratorSource(right_factory), name="right_src",
+                        parallelism=2)
+        .assign_timestamps(lambda r: int(r.meta["i"]) * 0.5 + 0.25,
+                           watermark_every=4, name="ts_right")
+        .key_by(lambda r: int(r.meta["key"]))
+    )
+
+    def join(l, r):
+        return TensorValue(
+            {"s": np.int64(int(l["v"]) + int(r["v"]))},
+            {"li": int(l.meta["i"]), "rj": int(r.meta["i"]),
+             "key": int(l.meta["key"])},
+        )
+
+    (
+        ls.interval_join(rs, lower_s=-1.6, upper_s=1.6)
+        .apply(join, name="ijoin", parallelism=args.par)
+        .add_sink(
+            ExactlyOnceRecordFileSink(os.path.join(args.out, "pairs")),
+            name="sink_pairs", parallelism=1)
+    )
+
+
 
 
 def _keyed_train_stage(env, args):
@@ -109,6 +232,26 @@ def _keyed_train_stage(env, args):
     )
 
 
+def _arm_self_kill(args):
+    """Fault injection for supervisor tests: hard-kill this process the
+    moment checkpoint ``--die-after-checkpoint`` is durable in our own
+    shard (a crash AFTER commit, the interesting recovery point)."""
+    import os
+    import signal
+    import threading
+    import time as _time
+
+    shard = os.path.join(args.chk, f"proc-{args.index:05d}")
+    target = os.path.join(shard, f"chk-{args.die_after_checkpoint:06d}")
+
+    def watch():
+        while not os.path.isdir(target):
+            _time.sleep(0.01)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--index", type=int, required=True)
@@ -117,10 +260,18 @@ def main():
     p.add_argument("--chk", default=None)
     p.add_argument("--n", type=int, default=80)
     p.add_argument("--every", type=int, default=20)
-    p.add_argument("--restore-id", type=int, default=-1)
+    p.add_argument("--restore-id", type=int, default=-1,
+                   help=">=0: explicit id; -1: fresh start; -2: AUTO — "
+                        "restore from the highest complete cohort "
+                        "checkpoint if any exists (elastic-supervisor "
+                        "respawns don't know the id in advance)")
+    p.add_argument("--die-after-checkpoint", type=int, default=0,
+                   help="fault injection: SIGKILL self once this "
+                        "checkpoint id is durable in the local shard")
     p.add_argument("--throttle", type=float, default=0.0)
     p.add_argument("--job", default="keyed_sum",
-                   choices=("keyed_sum", "keyed_window", "keyed_train"))
+                   choices=("keyed_sum", "keyed_window", "keyed_train",
+                            "event_time", "interval_join"))
     p.add_argument("--window", type=int, default=5)
     p.add_argument("--par", type=int, default=2, help="keyed-stage parallelism")
     args = p.parse_args()
@@ -133,6 +284,17 @@ def main():
                                           connect_timeout_s=30.0))
     if args.chk:
         env.enable_checkpointing(args.chk, every_n_records=args.every)
+    if args.die_after_checkpoint > 0 and args.chk:
+        _arm_self_kill(args)
+    if args.job in ("event_time", "interval_join"):
+        # Multi-sink jobs: the stage builders attach their own 2PC sinks
+        # under per-stream subdirectories of --out.
+        if args.job == "event_time":
+            _event_time_stages(env, args)
+        else:
+            _interval_join_stages(env, args)
+        env.execute("dist-plane", timeout=180, **_restore_kwargs(args))
+        return
     if args.job == "keyed_train":
         stage = _keyed_train_stage(env, args)
     elif args.job == "keyed_sum":
@@ -156,10 +318,25 @@ def main():
         stage = keyed.count_window(args.window, latency_budget_s=600.0).apply(
             WindowSum(), name="keyed_window", parallelism=args.par)
     stage.add_sink(ExactlyOnceRecordFileSink(args.out), name="sink", parallelism=1)
-    kw = {}
+    env.execute("dist-plane", timeout=180, **_restore_kwargs(args))
+
+
+def _restore_kwargs(args):
     if args.restore_id >= 0:
-        kw = dict(restore_from=args.chk, restore_checkpoint_id=args.restore_id)
-    env.execute("dist-plane", timeout=180, **kw)
+        return dict(restore_from=args.chk, restore_checkpoint_id=args.restore_id)
+    if args.restore_id == -2 and args.chk:
+        # AUTO: an elastic-supervisor respawn restores from the highest
+        # COMPLETE cohort checkpoint when one exists (selection validates
+        # the shard set against each shard's recorded participant set);
+        # a fresh base starts clean.
+        from flink_tensorflow_tpu.checkpoint.store import select_cohort_checkpoint
+
+        try:
+            cid, _ = select_cohort_checkpoint(args.chk)
+        except (FileNotFoundError, ValueError):
+            return {}
+        return dict(restore_from=args.chk, restore_checkpoint_id=cid)
+    return {}
 
 
 if __name__ == "__main__":

