@@ -1,0 +1,83 @@
+"""Reference-scale TF artifact through the loader path (VERDICT r3 #6).
+
+The reference's bread-and-butter artifact is a ~90MB Inception-v3
+SavedModel (BASELINE.json:7; SURVEY.md §2 loader rows).  The r3 proof
+stopped at a 5.3MB MLP; this module manufactures the real thing —
+``tf.keras.applications.InceptionV3(weights=None)``, ~95MB of variables,
+~190MB on disk — and pins that at TRUE scale: constant-bloat stays out
+of the lowered graph (weights land as executable ARGUMENTS), compile
+time stays bounded, and outputs match TF to float tolerance.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax  # noqa: E402
+
+from flink_tensorflow_tpu.models.tf_loader import TFSavedModelLoader  # noqa: E402
+
+#: InceptionV3 has ~23.85M parameters = ~95MB float32.
+MIN_WEIGHT_BYTES = 90_000_000
+
+
+@pytest.fixture(scope="module")
+def inception_savedmodel(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tfiv3") / "inception_v3")
+    model = tf.keras.applications.InceptionV3(weights=None, classes=1000)
+    model.export(path)  # serving_default over (None, 299, 299, 3) float32
+    size = sum(
+        os.path.getsize(os.path.join(r, f))
+        for r, _, fs in os.walk(path) for f in fs
+    )
+    assert size > MIN_WEIGHT_BYTES, f"artifact unexpectedly small: {size}"
+    return path
+
+
+@pytest.fixture(scope="module")
+def reference(inception_savedmodel):
+    sig = tf.saved_model.load(inception_savedmodel).signatures["serving_default"]
+    x = np.random.RandomState(3).rand(2, 299, 299, 3).astype(np.float32)
+    (out,) = sig(tf.constant(x)).values()
+    return x, out.numpy()
+
+
+class TestReferenceScaleArtifact:
+    def test_weights_extracted_at_scale(self, inception_savedmodel):
+        model = TFSavedModelLoader(
+            inception_savedmodel, extract_weights=True).load()
+        total = sum(np.asarray(v).nbytes for v in model.params.values())
+        assert total >= MIN_WEIGHT_BYTES, (
+            f"only {total} bytes extracted — the ~95MB of Inception "
+            "variables must lift out of the graph"
+        )
+        assert model.metadata["weights"] == "extracted_params"
+
+    def test_outputs_match_tf_with_bounded_compile(
+            self, inception_savedmodel, reference):
+        x, ref = reference
+        model = TFSavedModelLoader(
+            inception_savedmodel, extract_weights=True).load()
+        method = model.method("serve")
+        serve = method.fn
+        f = jax.jit(lambda p, inp: serve(p, inp))
+        in_name = method.input_schema.names[0]
+        t0 = time.monotonic()
+        compiled = f.lower(model.params, {in_name: x}).compile()
+        compile_s = time.monotonic() - t0
+        # Constant-bloat check AT SCALE: the ~95MB of weights must enter
+        # as executable arguments (HBM-resident, reused across calls),
+        # not as literals that would re-lower per bucket shape.
+        ma = compiled.memory_analysis()
+        assert ma.argument_size_in_bytes >= MIN_WEIGHT_BYTES
+        # Bounded compile: extraction keeps lowering proportional to the
+        # GRAPH, not the weight bytes (generous bound for a loaded CI
+        # host — the point is "minutes, not unbounded").
+        assert compile_s < 300, f"compile took {compile_s:.1f}s"
+        outputs = compiled(model.params, {in_name: x})
+        (got,) = [np.asarray(v) for v in outputs.values()]
+        np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
