@@ -183,6 +183,33 @@ def _wire_probe(dev, *, smoke: bool = False, micro: bool = False) -> dict:
     }
 
 
+def _cap_to_peak(out: dict, degenerate: bool, peak_tflops,
+                 flops_per_unit: float, rewrite) -> dict:
+    """Shared physical-sanity cap for compute probes: a degenerate or
+    above-peak reading is a BOUND, not a measurement — rewrite every
+    rate field to the peak-implied value (``rewrite(out, units_per_s)``;
+    called with None when no peak is known, meaning withhold) and flag
+    the probe invalid.  One implementation so the cap semantics cannot
+    drift between the forward and train-step probes."""
+    achieved = out.get("achieved_tflops")
+    above = (
+        peak_tflops is not None and achieved is not None
+        and achieved > peak_tflops
+    )
+    if not degenerate and not above:
+        return out
+    if peak_tflops is not None:
+        rewrite(out, peak_tflops * 1e12 / flops_per_unit)
+        out["achieved_tflops"] = peak_tflops
+        out["mfu_pct"] = 100.0
+    else:
+        rewrite(out, None)
+        out["achieved_tflops"] = None
+        out["mfu_pct"] = None
+    out["probe_invalid_capped_to_peak"] = True
+    return out
+
+
 def _delta_timing(run_once, k1: int, k2: int, *, widen_once: bool = True):
     """Median-of-3 timed K-iteration dispatches, differenced so the
     fixed per-call round trip cancels.  Shared by the forward and
@@ -302,26 +329,16 @@ def _compute_probe(model, probe_b: int, dev, *, smoke: bool = False) -> dict:
             else None
         ),
     }
-    # Hard physical-sanity bound: a compute-rate claim above chip peak
-    # means the probe (not the chip) is broken — cap it and say so.
-    if probe_degenerate or (
-            peak_tflops is not None and achieved_tflops > peak_tflops):
-        if peak_tflops is not None:
-            # Report the peak-derived UPPER BOUND, flagged invalid — and
-            # keep every derived field consistent with it.
-            out["records_per_sec"] = round(
-                peak_tflops * 1e12 / (flops_per_fwd / probe_b), 1)
-            out["per_record_us"] = round(1e6 / out["records_per_sec"], 2)
-            out["achieved_tflops"] = peak_tflops
-            out["mfu_pct"] = 100.0
+    def rewrite(o, records_per_s_bound):
+        if records_per_s_bound is not None:
+            o["records_per_sec"] = round(records_per_s_bound, 1)
+            o["per_record_us"] = round(1e6 / records_per_s_bound, 2)
         else:
-            # No peak to cap against: emit nothing rather than garbage.
-            out["records_per_sec"] = None
-            out["per_record_us"] = None
-            out["achieved_tflops"] = None
-            out["mfu_pct"] = None
-        out["probe_invalid_capped_to_peak"] = True
-    return out
+            o["records_per_sec"] = None
+            o["per_record_us"] = None
+
+    return _cap_to_peak(out, probe_degenerate, peak_tflops,
+                        flops_per_fwd / probe_b, rewrite)
 
 
 def _conv_dtype_report(model, probe_b: int = 8) -> typing.List[str]:
@@ -450,17 +467,15 @@ def _train_compute_probe(dev, *, smoke: bool = False) -> dict:
         "chip_peak_bf16_tflops": peak,
         "mfu_pct": round(100.0 * achieved / peak, 2) if peak else None,
     }
-    if degenerate or (peak is not None and achieved > peak):
-        if peak is not None:
-            out["steps_per_sec"] = round(peak * 1e12 / flops_per_step, 3)
-            out["records_per_sec"] = round(out["steps_per_sec"] * b, 1)
-            out["achieved_tflops"] = peak
-            out["mfu_pct"] = 100.0
+    def rewrite(o, steps_per_s_bound):
+        if steps_per_s_bound is not None:
+            o["steps_per_sec"] = round(steps_per_s_bound, 3)
+            o["records_per_sec"] = round(steps_per_s_bound * b, 1)
         else:
-            out["steps_per_sec"] = out["records_per_sec"] = None
-            out["achieved_tflops"] = out["mfu_pct"] = None
-        out["probe_invalid_capped_to_peak"] = True
-    return out
+            o["steps_per_sec"] = None
+            o["records_per_sec"] = None
+
+    return _cap_to_peak(out, degenerate, peak, flops_per_step, rewrite)
 
 
 # ---------------------------------------------------------------------------
@@ -495,6 +510,14 @@ def _steady_rps(arrivals, total_records, first_batch, n_chips,
             f"trailing={trailing_exclude})"
         )
     last = len(arrivals) - 1 - trailing_exclude
+    if last < 1:
+        # A short arrivals list would wrap the index negative and emit a
+        # silent nonsense rate — loud failure instead (measurement
+        # integrity is the whole point of this helper).
+        raise ValueError(
+            f"arrivals ({len(arrivals)}) shorter than the records the "
+            f"exclusions assume (trailing={trailing_exclude})"
+        )
     span = arrivals[last] - arrivals[0]
     steady = total_records - first_batch - trailing_exclude
     return (steady / span if span > 0 else float("nan")) / max(1, n_chips), span

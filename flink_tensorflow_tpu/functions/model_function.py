@@ -87,6 +87,34 @@ class _ModelFunctionBase(fn.RichFunction):
         latency budget (WindowOperator feeds it to the trigger)."""
         return self.runner.service_ewma_s if self.runner is not None else None
 
+    def _poll_collect(self, now: float) -> None:
+        """Shared timer-poll body (requires ``self._idle_flush_s``):
+        emit every batch whose results are READY without blocking, then
+        apply the stall fallback — one blocking fetch if the oldest
+        batch has been pending far longer than the observed service
+        time (a backend whose is_ready never reports, or a wedged
+        transfer), so results cannot strand forever.  The threshold
+        rides the service EWMA so legitimately slow batches
+        (multi-second wire transfers at large buckets) never trip it;
+        before ANY observation exists (warmup resets the EWMA) the
+        guard is a generous constant — the first post-warmup batch on a
+        slow transport can take seconds, and tripping on it would
+        reintroduce the blocking M/D/1 behavior this path removes."""
+        if self.runner is None or self._out is None:
+            return
+        for record in self.runner.collect_available():
+            self._out.collect(record)
+        age = self.runner.oldest_pending_age_s(now)
+        if age is not None:
+            svc = self.runner.service_ewma_s
+            stall_s = max(30.0 if svc is None else 1.0,
+                          10.0 * self._idle_flush_s,
+                          4.0 * svc if svc is not None else 0.0)
+            if age > stall_s:
+                for record in self.runner.collect_ready(
+                        len(self.runner._pending) - 1):
+                    self._out.collect(record)
+
     def clone(self) -> "fn.Function":
         # Subtasks share the host-side source (read-only); each builds its
         # own runner/device placement at open().  Deepcopying params per
@@ -171,11 +199,13 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
         self._idle_flush_s = idle_flush_s
         self._buf: typing.List[typing.Any] = []
         self._last_activity: typing.Optional[float] = None
+        self._last_poll: typing.Optional[float] = None
 
     def clone(self) -> "fn.Function":
         dup = super().clone()
         dup._buf = []
         dup._last_activity = None
+        dup._last_poll = None
         return dup
 
     def map_async(self, value, out: fn.Collector):
@@ -184,7 +214,7 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
         if len(self._buf) >= self._micro_batch:
             self._dispatch_buf()
         self._last_activity = time.monotonic()
-        for record in self.runner.collect_ready(self._max_in_flight):
+        for record in self.runner.collect_progress(self._max_in_flight):
             out.collect(record)
 
     def _dispatch_buf(self):
@@ -200,17 +230,27 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
                 out.collect(record)
 
     # -- latency bound in a lull (MapOperator timer hooks) ---------------
+    # Same poll-don't-block discipline as the windowed path: the idle
+    # deadline DISPATCHES the partial micro-batch (the latency bound on
+    # buffered records), then emits whatever is ready without parking
+    # the subtask thread for the device round trip.
     def next_deadline(self) -> typing.Optional[float]:
         if self._last_activity is None:
             return None
         if not self._buf and not (self.runner and self.runner._pending):
             return None
-        return self._last_activity + self._idle_flush_s
+        base = self._last_activity
+        if self._last_poll is not None and self._last_poll > base:
+            base = self._last_poll
+        return base + self._idle_flush_s
 
     def fire_due(self, now: float) -> None:
         d = self.next_deadline()
-        if d is not None and now >= d:
-            self.flush()
+        if d is None or now < d:
+            return
+        self._dispatch_buf()
+        self._poll_collect(now)
+        self._last_poll = now
 
     def on_finish(self, out: fn.Collector):
         self.flush(out)
@@ -395,7 +435,7 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
             cap = policy.fixed_batch or policy.batch.sizes[-1]
             for i in range(0, len(elements), cap):
                 self.runner.dispatch(elements[i:i + cap])
-                for record in self.runner.collect_ready(self._max_in_flight):
+                for record in self.runner.collect_progress(self._max_in_flight):
                     out.collect(record)
         self._last_dispatch = time.monotonic()
 
@@ -448,7 +488,7 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
             batch = Batch(arrays=arrays, valid=valid, lengths={},
                           metas=[t.meta for t in chunk])
             self.runner.dispatch_batch(batch, on_done=release)
-            for record in self.runner.collect_ready(self._max_in_flight):
+            for record in self.runner.collect_progress(self._max_in_flight):
                 out.collect(record)
 
     # Timer hooks (WindowOperator.next_deadline/fire_due): while batches
@@ -471,31 +511,10 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
 
     def fire_due(self, now: float) -> None:
         d = self.next_deadline()
-        if d is None or now < d or self._out is None:
+        if d is None or now < d:
             return
-        for record in self.runner.collect_available():
-            self._out.collect(record)
+        self._poll_collect(now)
         self._last_poll = now
-        # Stall fallback: if the oldest batch has been pending for far
-        # longer than the observed service time (a backend whose
-        # is_ready never reports, or a wedged transfer), fall back to
-        # ONE blocking fetch so results cannot strand forever.  The
-        # threshold rides the service EWMA so legitimately slow batches
-        # (multi-second wire transfers at large buckets) never trip it;
-        # before ANY observation exists (warmup resets the EWMA) the
-        # guard is a generous constant — the first post-warmup batch on
-        # a slow transport can legitimately take seconds, and tripping
-        # on it would reintroduce the blocking fetch this path removes.
-        age = self.runner.oldest_pending_age_s(now)
-        if age is not None:
-            svc = self.runner.service_ewma_s
-            stall_s = max(30.0 if svc is None else 1.0,
-                          10.0 * self._idle_flush_s,
-                          4.0 * svc if svc is not None else 0.0)
-            if age > stall_s:
-                for record in self.runner.collect_ready(
-                        len(self.runner._pending) - 1):
-                    self._out.collect(record)
 
     def on_finish(self, out: fn.Collector):
         for record in self.runner.flush():

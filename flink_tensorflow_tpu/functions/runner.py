@@ -263,11 +263,15 @@ class CompiledMethodRunner:
         return batch, outputs, timings, on_done
 
     def _fetch_oldest(self) -> typing.List[TensorValue]:
-        t_fetch_start = time.monotonic()
         item = self._pending.popleft()
         self._pending_t0.popleft()
         if isinstance(item, concurrent.futures.Future):
             item = item.result()  # re-raises lane-thread failures here
+        # Stamped AFTER the lane future resolves: a blocking collect can
+        # enter here while the lane is still transferring, and that wait
+        # belongs to ready_wait (t_dispatched -> t_fetch_start), keeping
+        # the stage boundaries monotone and exactly tiling t0..t_done.
+        t_fetch_start = time.monotonic()
         batch, outputs, timings, on_done = item
         host = DeviceTransfer.fetch(outputs)  # blocks on this batch only
         t_done = time.monotonic()
@@ -358,6 +362,16 @@ class CompiledMethodRunner:
         out: typing.List[TensorValue] = []
         while self._oldest_available():
             out.extend(self._fetch_oldest())
+        return out
+
+    def collect_progress(self, max_in_flight: int) -> typing.List[TensorValue]:
+        """Opportunistic collection on the hot path: everything already
+        READY (non-blocking), then block only as far as the pipeline-
+        depth bound requires.  Keeps emission latency at one arrival
+        interval instead of one pipeline drain without sacrificing the
+        depth backpressure."""
+        out = self.collect_available()
+        out.extend(self.collect_ready(max_in_flight))
         return out
 
     def oldest_pending_age_s(self, now: typing.Optional[float] = None) -> typing.Optional[float]:

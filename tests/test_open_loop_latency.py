@@ -251,6 +251,41 @@ class TestArrivalStamp:
         assert "__arrive_ts__" not in tv.meta
 
 
+class TestAsyncMapPolling:
+    def test_partial_batch_dispatches_and_emits_via_poll(self):
+        """The async map's idle deadline must dispatch the partial
+        micro-batch and surface its results through the non-blocking
+        poll — without end-of-input and without reaching the pipeline
+        depth (the map-path twin of the windowed fix)."""
+        import jax
+
+        from flink_tensorflow_tpu.functions import ModelMapFunction
+        from flink_tensorflow_tpu.models import get_model_def
+        from flink_tensorflow_tpu.core import functions as fn
+
+        mdef = get_model_def("lenet", num_classes=10)
+        model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+        f = ModelMapFunction(model, micro_batch=8, idle_flush_s=0.005,
+                             transfer_lanes=2)
+        emitted = []
+        out = fn.Collector(lambda v, ts=None: emitted.append(v))
+        f.open(None)
+        try:
+            for r in _recs(3):  # partial: under the micro_batch of 8
+                f.map_async(r, out)
+            assert f._buf, "partial batch should still be buffered"
+            deadline = time.monotonic() + 10.0
+            while len(emitted) < 3 and time.monotonic() < deadline:
+                d = f.next_deadline()
+                if d is not None:
+                    time.sleep(max(0.0, min(d - time.monotonic(), 0.01)))
+                    f.fire_due(time.monotonic())
+            assert len(emitted) == 3
+            assert not f._buf and not f.runner._pending
+        finally:
+            f.close()
+
+
 class TestPollingEmission:
     def test_window_results_emitted_by_poll_not_depth(self):
         """One fired window's results must surface via the fire_due poll
