@@ -1030,13 +1030,21 @@ def bench_inception(args) -> dict:
         gap_s = 1.0 / rate if rate else 0.0
         operating_floor_s = (
             gap_s + rtt_s + med_batch * one_record_wire_s + idle_flush_s)
-        # Achieved service rate over the emission span: when the tunnel's
-        # bandwidth drops below the offered load mid-pass (its token-
-        # bucket swings 3-22 MB/s), the queue grows and p50 measures the
-        # TRANSPORT's shortfall — the saturated flag says so explicitly.
-        emits = sorted(s + l for s, l, _ in samples)
-        span = emits[-1] - emits[0] if len(emits) > 1 else float("nan")
-        achieved = (len(emits) - 1) / span if span > 0 else float("nan")
+        # Achieved service rate over the STEADY samples, anchored at
+        # their first scheduled arrival (not the first emission): when
+        # emissions burst — host starvation, backlog drains — an
+        # emission-to-emission span compresses and can report
+        # achieved > offered, silently defeating the saturation check.
+        # Using the steady subset keeps one-time warmup out of the
+        # anchor (same filter as p50/p99), and the schedule anchor
+        # bounds achieved by the offered process.
+        if steady:
+            sched0 = min(s for s, l, _ in steady)
+            last_emit = max(s + l for s, l, _ in steady)
+            span = last_emit - sched0
+            achieved = len(steady) / span if span > 0 else float("nan")
+        else:
+            achieved = float("nan")
         saturated = bool(achieved < 0.9 * rate) if achieved == achieved else True
         floor_ms = floor_s * 1e3
         out["open_loop"] = {
