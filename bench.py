@@ -766,10 +766,10 @@ def bench_inception(args) -> dict:
         # Fraction of the transport's own measured ceiling the full
         # pipeline achieves — the framework-overhead number (1.0 means
         # every sustained wire byte became a scored record).  Computed
-        # against the UPPER bracket, so a value > 1.0 is impossible
-        # unless the transport changed state mid-pass — which is then
-        # declared in ceiling_drift instead of masquerading as >100%
-        # efficiency.
+        # against the UPPER bracket; any value above 1.0 carries a
+        # ceiling_drift annotation — "probe noise / mild drift" up to
+        # 1.05, "transport changed state mid-pass, unreliable" beyond —
+        # so it can never silently masquerade as >100% efficiency.
         "pipeline_efficiency_vs_wire_ceiling": (
             round(rps_per_chip / ceiling_hi, 3)
             if ceiling_hi == ceiling_hi and ceiling_hi > 0
@@ -782,13 +782,19 @@ def bench_inception(args) -> dict:
             else None
         ),
         "ceiling_drift": (
-            "measured pipeline rate exceeds BOTH bracketing wire probes: "
-            "the transport changed state mid-pass (token-bucket refill "
-            "or upstream content caching) — efficiency is unreliable "
-            "for this run"
-            if (ceiling_hi == ceiling_hi and ceiling_hi > 0
-                and rps_per_chip > 1.05 * ceiling_hi)
-            else None
+            None if not (ceiling_hi == ceiling_hi and ceiling_hi > 0
+                         and rps_per_chip > ceiling_hi)
+            else (
+                "measured pipeline rate exceeds BOTH bracketing wire "
+                "probes: the transport changed state mid-pass "
+                "(token-bucket refill or upstream content caching) — "
+                "efficiency is unreliable for this run"
+                if rps_per_chip > 1.05 * ceiling_hi
+                else
+                "pipeline rate marginally above the upper bracket "
+                "(<=5%): within probe noise / mild mid-pass drift of "
+                "the transport's sustained rate"
+            )
         ),
         # Host-attached-chip projection derives from the MEASURED
         # on-device rate — a PCIe h2d >= 10 GB/s makes ingest overlap
