@@ -1,0 +1,216 @@
+"""Round-5 regression pins (VERDICT r4 #1/#6 + ADVICE r4).
+
+Each test pins a defect found in the round-5 adversarial sweep over the
+round-4 surface, or a contract the final round's auditability depends
+on:
+
+1. BENCH_r04.json archived with ``parsed: null`` — the single
+   full-detail JSON line outgrew the driver's ~2KB stdout tail capture,
+   so the round's headline driver-run numbers were LOST.  bench.py now
+   prints a compact scoreboard as the FINAL stdout line (full detail to
+   earlier lines + BENCH_full.json); the scoreboard must stay under the
+   tail window whatever fields future edits add.
+"""
+
+import json
+
+import bench
+
+
+def _flagship_out():
+    """A full-detail Inception output dict with every round-4 field
+    populated at realistic magnitudes (shapes from the BENCH_r03/r04
+    archives), so the size test measures the real serialized widths."""
+    sweep = [
+        {"probe_batch": b, "per_record_us": 161.61, "records_per_sec": 6187.7,
+         "flops_per_record": 24061773527.0, "flops_source": "xla_cost_analysis",
+         "achieved_tflops": 79.43, "device_kind": "TPU v5 lite",
+         "chip_peak_bf16_tflops": 197.0, "mfu_pct": 40.32}
+        for b in (256, 512, 1024)
+    ]
+    return {
+        "metric": "inception_v3_streaming_inference_records_per_sec_per_chip",
+        "value": 49.19, "unit": "records/s/chip", "vs_baseline": 0.328,
+        "p50_record_latency_ms": 2862.426, "p99_record_latency_ms": 4880.896,
+        "records": 2048, "batch": 128, "transfer_lanes": 6,
+        "rps_first_half": 48.3, "rps_second_half": 51.08, "chips": 1,
+        "platform": "tpu",
+        "decomposition_per_batch": {
+            "host_assemble_s_p50": 0.05922, "h2d_bytes": 34330030,
+            "h2d_plus_dispatch_s_p50": 2.38717, "steady_state_s": 2.6022,
+            "device_compute_s": 0.02069, "fixed_call_roundtrip_s": 0.09334,
+        },
+        "wire": {"sustained_mb_s": 4.71, "burst_mb_s": 443.2,
+                 "bucket_mb": 134.0, "record_bytes": 268203,
+                 "wire_ceiling_records_per_sec": 17.6},
+        "wire_pre": {"sustained_mb_s": 5.39,
+                     "wire_ceiling_records_per_sec": 20.1},
+        "wire_ceiling_records_per_sec_range": [17.6, 20.1],
+        "device_compute": sweep[1],
+        "device_compute_sweep": sweep,
+        "conv_dtypes": ["bf16"],
+        "device_compute_train_resnet50": {
+            "workload": "resnet50_train_step", "probe_batch": 128,
+            "image_size": 224, "steps_per_sec": 20.876,
+            "records_per_sec": 2672.1, "flops_per_step": 3060412973056.0,
+            "flops_source": "xla_cost_analysis", "achieved_tflops": 63.89,
+            "chip_peak_bf16_tflops": 197.0, "mfu_pct": 32.43,
+        },
+        "bottleneck": "host->device wire bandwidth of the tunnel-attached device",
+        "pipeline_efficiency_vs_wire_ceiling": 0.942,
+        "pipeline_efficiency_range": [0.942, 1.04],
+        "ceiling_drift": None,
+        "ceiling_drift_code": None,
+        "projected_records_per_sec_host_attached_chip": 6187.7,
+        "projected_vs_baseline": 41.3,
+        "baseline_note": "reference published no numbers (BASELINE.json "
+                         "published={}); vs_baseline uses a 150 rec/s/GPU estimate",
+        "open_loop": {
+            "arrival_process": "poisson", "offered_rate_rps": 8.92,
+            "rate_fraction_of_capacity": 0.5, "service_capacity_rps": 21.33,
+            "capacity_cap_rps": 17.84, "service_batch": 16,
+            "trigger": "adaptive_latency_ewma+service_reserve",
+            "result_collection": "ready-poll every 15ms",
+            "latency_budget_requested_ms": 300.0, "latency_budget_ms": 300.0,
+            "budget_auto_raised": False, "latency_floor_ms": 158.1,
+            "floor_components_ms": {"fixed_call_roundtrip": 93.3,
+                                    "one_record_wire": 49.8,
+                                    "collection_poll": 15.0},
+            "records": 512, "steady_state_samples": 485,
+            "warmup_contaminated": False, "achieved_rate_rps": 8.87,
+            "saturated": False,
+            "wire_sustained_mb_s_bracket": [5.39, 4.71],
+            "offered_mb_s": 2.39, "p50_latency_ms": 814.9,
+            "p99_latency_ms": 1891.2, "p50_over_floor": 5.15,
+            "median_fired_window": 3,
+            "latency_floor_at_operating_point_ms": 403.4,
+            "p50_over_operating_floor": 2.02, "budget_met": False,
+            "per_sample_decomposition_ms": {
+                k: {"p50_ms": 100.0, "p99_ms": 1000.0}
+                for k in ("queue_wait", "trigger_hold", "lane_wait",
+                          "h2d_dispatch", "ready_wait", "fetch", "emit")
+            },
+        },
+    }
+
+
+def _secondary_outs():
+    return [
+        {"metric": "mnist_lenet_windowed_records_per_sec", "value": 1888.3,
+         "unit": "records/s", "vs_baseline": None},
+        {"metric": "bilstm_dynamic_batching_records_per_sec", "value": 555.4,
+         "unit": "records/s", "vs_baseline": None},
+        {"metric": "widedeep_online_training_steps_per_sec", "value": 20.1,
+         "unit": "steps/s", "vs_baseline": None},
+        {"metric": "resnet50_dp_training_records_per_sec_per_chip",
+         "value": 72.7, "unit": "records/s/chip", "vs_baseline": None},
+    ]
+
+
+class TestScoreboardLine:
+    """VERDICT r4 #1: the final stdout line must fit the driver tail."""
+
+    def test_fits_tail_window_with_all_workloads(self):
+        sb = bench._fit_scoreboard(
+            bench._scoreboard([_flagship_out(), *_secondary_outs()]))
+        line = json.dumps(sb, allow_nan=False)
+        assert len(line.encode()) <= bench.SCOREBOARD_MAX_BYTES
+        # Strict RFC-8259 round trip.
+        back = json.loads(line)
+        assert back["scoreboard"] is True
+
+    def test_carries_every_headline_field(self):
+        sb = bench._fit_scoreboard(
+            bench._scoreboard([_flagship_out(), *_secondary_outs()]))
+        # Headline rate + latency.
+        assert sb["value"] == 49.19 and sb["unit"] == "records/s/chip"
+        assert sb["p50_ms"] == 2862.426 and sb["p99_ms"] == 4880.896
+        # Wire bracket, efficiency, drift verdict.
+        assert sb["wire_mb_s_bracket"] == [5.39, 4.71]
+        assert sb["eff_vs_wire_ceiling"] == 0.942
+        assert sb["ceiling_drift"] is None
+        # MFU characterization: forward sweep + train step.
+        assert [b for b, _ in sb["mfu_sweep_batch_pct"]] == [256, 512, 1024]
+        assert sb["resnet_train"]["mfu_pct"] == 32.43
+        # Open-loop digest: p50, both floors, floor-multiple, verdicts.
+        ol = sb["open_loop"]
+        assert ol["p50_ms"] == 814.9 and ol["floor_ms"] == 158.1
+        assert ol["op_floor_ms"] == 403.4
+        assert ol["p50_over_op_floor"] == 2.02
+        assert ol["budget_met"] is False and ol["saturated"] is False
+        # One row per secondary workload.
+        assert set(sb["workloads"]) == {"mnist", "bilstm", "widedeep",
+                                        "resnet50"}
+        assert sb["full_detail"] == "BENCH_full.json"
+
+    def test_drift_verdict_copied_from_machine_code(self):
+        # The digest copies the machine-readable ceiling_drift_code the
+        # source emits next to the prose — rewording the prose can never
+        # flip the severity the driver-parsed line reports.
+        out = _flagship_out()
+        out["ceiling_drift"] = "some future rewording of the severe message"
+        out["ceiling_drift_code"] = "unreliable"
+        assert bench._scoreboard([out])["ceiling_drift"] == "unreliable"
+        out["ceiling_drift_code"] = None
+        assert bench._scoreboard([out])["ceiling_drift"] is None
+
+    def test_drift_prose_fallback_for_pre_r5_dicts(self):
+        out = _flagship_out()
+        del out["ceiling_drift_code"]
+        out["ceiling_drift"] = ("measured pipeline rate exceeds BOTH "
+                                "bracketing wire probes ... efficiency is "
+                                "unreliable for this run")
+        assert bench._scoreboard([out])["ceiling_drift"] == "unreliable"
+        out["ceiling_drift"] = ("pipeline rate marginally above the upper "
+                                "bracket (<=5%) ...")
+        assert bench._scoreboard([out])["ceiling_drift"] == "marginal<=5%"
+
+    def test_fit_drops_optional_blocks_never_headline(self):
+        sb = bench._scoreboard([_flagship_out(), *_secondary_outs()])
+        sb["workloads"]["padded"] = ["x" * 4000, "records/s"]
+        fitted = bench._fit_scoreboard(sb)
+        line = json.dumps(fitted, allow_nan=False)
+        assert len(line.encode()) <= bench.SCOREBOARD_MAX_BYTES
+        # The oversized block went; the headline and open-loop stayed.
+        assert "workloads" not in fitted
+        assert fitted["value"] == 49.19
+        assert fitted["open_loop"]["p50_ms"] == 814.9
+
+    def test_main_prints_scoreboard_last_and_writes_full(self, tmp_path,
+                                                         monkeypatch, capsys):
+        """End-to-end emission contract without real compute: stub the
+        workload table, run main(), assert the FINAL stdout line is the
+        compact scoreboard and the full detail landed in the file."""
+        flag = _flagship_out()
+        monkeypatch.setattr(bench, "WORKLOADS",
+                            {"inception": lambda args: flag})
+        monkeypatch.setattr(bench, "BENCH_FULL_PATH",
+                            str(tmp_path / "BENCH_full.json"))
+        bench.main(["--workload", "inception"])
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 2  # full-detail line, then the scoreboard
+        full_line = json.loads(lines[0])
+        assert full_line["metric"] == flag["metric"]
+        last = lines[-1]
+        assert len(last.encode()) <= bench.SCOREBOARD_MAX_BYTES
+        sb = json.loads(last)
+        assert sb["scoreboard"] is True and sb["value"] == flag["value"]
+        on_disk = json.loads((tmp_path / "BENCH_full.json").read_text())
+        assert on_disk["workloads"][0]["metric"] == flag["metric"]
+
+    def test_full_detail_pointer_null_when_write_fails(self, tmp_path,
+                                                       monkeypatch, capsys):
+        """A stale BENCH_full.json from a previous run must not be
+        advertised as this run's detail: on write failure the scoreboard
+        pointer is null."""
+        monkeypatch.setattr(bench, "WORKLOADS",
+                            {"inception": lambda args: _flagship_out()})
+        # A path whose parent does not exist fails the open with an
+        # OSError even when running as root (chmod-based denial doesn't).
+        monkeypatch.setattr(bench, "BENCH_FULL_PATH",
+                            str(tmp_path / "missing-dir" / "BENCH_full.json"))
+        bench.main(["--workload", "inception"])
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        sb = json.loads(lines[-1])
+        assert sb["scoreboard"] is True
+        assert sb["full_detail"] is None
