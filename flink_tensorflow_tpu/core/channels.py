@@ -59,6 +59,20 @@ class InputGate:
                 continue
         # Gate torn down (job cancelled/finished): drop silently.
 
+    def wake(self) -> None:
+        """Break a blocked :meth:`poll` immediately.
+
+        For operator-owned background threads (e.g. the model runner's
+        fetch thread) whose completions should be handled NOW rather
+        than after the subtask loop's poll timeout expires.  The sentinel
+        makes ``poll`` return None early; the loop then re-evaluates the
+        operator's ``next_deadline`` and fires.  Lossless: no stream
+        element is consumed or reordered."""
+        try:
+            self._queue.put_nowait((-1, None))
+        except queue.Full:
+            pass  # a full queue wakes the reader on its own
+
     # -- reader side (single consumer thread) --------------------------
     def poll(self, timeout: typing.Optional[float] = None) -> typing.Optional[typing.Tuple[int, el.StreamElement]]:
         """Next (channel, element) honoring blocked channels; None on timeout."""
@@ -77,6 +91,8 @@ class InputGate:
                 if deadline is not None and _now() >= deadline:
                     return None
                 continue
+            if idx < 0:
+                return None  # wake() sentinel: hand control back NOW
             if self._blocked[idx]:
                 self._stashed[idx].append((idx, element))
                 continue

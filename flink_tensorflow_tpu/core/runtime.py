@@ -378,6 +378,12 @@ class LocalExecutor:
                     process_index=proc_idx,
                     num_processes=num_procs,
                 )
+                gate = getattr(st, "gate", None)
+                if gate is not None:
+                    # Operator-owned background threads (the model
+                    # runner's fetch thread) use this to break the
+                    # subtask loop's poll sleep when results complete.
+                    ctx.wakeup = gate.wake
                 st.operator.setup(ctx, st.output, state)
                 self.subtasks.append(st)
 

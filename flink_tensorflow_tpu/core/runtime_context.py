@@ -48,6 +48,12 @@ class RuntimeContext:
         #: validate one-subtask-per-process placement.
         self.process_index = process_index
         self.num_processes = num_processes
+        #: Zero-arg callable breaking the subtask loop's poll sleep —
+        #: operator-owned background threads (the model runner's fetch
+        #: thread) call it when async results complete, so emission
+        #: doesn't wait out the poll interval.  None for source subtasks
+        #: (no input gate) and bare-function tests.
+        self.wakeup: typing.Optional[typing.Callable[[], None]] = None
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)

@@ -88,32 +88,18 @@ class _ModelFunctionBase(fn.RichFunction):
         return self.runner.service_ewma_s if self.runner is not None else None
 
     def _poll_collect(self, now: float) -> None:
-        """Shared timer-poll body (requires ``self._idle_flush_s``):
-        emit every batch whose results are READY without blocking, then
-        apply the stall fallback — one blocking fetch if the oldest
-        batch has been pending far longer than the observed service
-        time (a backend whose is_ready never reports, or a wedged
-        transfer), so results cannot strand forever.  The threshold
-        rides the service EWMA so legitimately slow batches
-        (multi-second wire transfers at large buckets) never trip it;
-        before ANY observation exists (warmup resets the EWMA) the
-        guard is a generous constant — the first post-warmup batch on a
-        slow transport can take seconds, and tripping on it would
-        reintroduce the blocking M/D/1 behavior this path removes."""
+        """Shared timer-poll body: emit every batch the runner's fetch
+        thread has completed.  Never blocks — the blocking d2h round
+        trip runs on the fetch thread (r5), which also retired the r4
+        stall fallback here: that fallback existed for backends whose
+        ``is_ready`` never reports (and its one-batch-per-poll drain was
+        ADVICE r4's third finding), but the fetch thread does not
+        consult readiness at all — a blocking fetch IS the completion
+        signal, so results cannot strand behind a readiness lie."""
         if self.runner is None or self._out is None:
             return
         for record in self.runner.collect_available():
             self._out.collect(record)
-        age = self.runner.oldest_pending_age_s(now)
-        if age is not None:
-            svc = self.runner.service_ewma_s
-            stall_s = max(30.0 if svc is None else 1.0,
-                          10.0 * self._idle_flush_s,
-                          4.0 * svc if svc is not None else 0.0)
-            if age > stall_s:
-                for record in self.runner.collect_ready(
-                        len(self.runner._pending) - 1):
-                    self._out.collect(record)
 
     def clone(self) -> "fn.Function":
         # Subtasks share the host-side source (read-only); each builds its
@@ -138,6 +124,10 @@ class _ModelFunctionBase(fn.RichFunction):
         )
         self.runner.stamp_stages = self._stamp_stages
         self.runner.open(ctx)
+        # Completed results wake the subtask loop immediately (instead of
+        # waiting out the poll interval) when the runtime provides a
+        # gate wakeup hook.
+        self.runner.on_results_ready = getattr(ctx, "wakeup", None)
         if self._warmup:
             self.runner.warmup(self._warmup, self._warmup_length_bucket)
 
@@ -234,7 +224,10 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
     # deadline DISPATCHES the partial micro-batch (the latency bound on
     # buffered records), then emits whatever is ready without parking
     # the subtask thread for the device round trip.
-    def next_deadline(self) -> typing.Optional[float]:
+    def _idle_deadline(self) -> typing.Optional[float]:
+        """The idle-flush deadline proper: when the buffered partial
+        micro-batch must dispatch (the latency bound on buffered
+        records)."""
         if self._last_activity is None:
             return None
         if not self._buf and not (self.runner and self.runner._pending):
@@ -244,11 +237,29 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
             base = self._last_poll
         return base + self._idle_flush_s
 
+    def next_deadline(self) -> typing.Optional[float]:
+        # Fetched results waiting: due IMMEDIATELY — 0.0 is in the past
+        # on the monotonic clock, so the caller's earlier `now` still
+        # satisfies `now >= deadline` (a fresh monotonic() here could
+        # exceed it and skip the fire).  The fetch thread also pokes the
+        # gate via on_results_ready, so the loop re-checks within one
+        # poll rather than one idle_flush interval.
+        if self.runner is not None and self.runner.has_completed():
+            return 0.0
+        return self._idle_deadline()
+
     def fire_due(self, now: float) -> None:
         d = self.next_deadline()
         if d is None or now < d:
             return
-        self._dispatch_buf()
+        # Dispatch the partial buffer only when the IDLE deadline proper
+        # expired — a completion-driven wake (deadline 0.0) must drain
+        # results, not force half-full micro-batches out at every batch
+        # completion (that would defeat micro-batching under steady
+        # load: each completion would flush a partial, padded batch).
+        idle = self._idle_deadline()
+        if idle is not None and now >= idle:
+            self._dispatch_buf()
         self._poll_collect(now)
         self._last_poll = now
 
@@ -376,9 +387,16 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
         tv = value if isinstance(value, TensorValue) else coerce(
             value, self.runner.method.input_schema)
         while not self._ring.try_push(tv.fields):
-            # Ring full: the oldest in-flight batch holds slots — collect
-            # it (releases on fetch) and retry.  No in-flight work means
-            # the buffered window alone exceeds capacity: list-buffer it.
+            # Ring full: completed-but-uncollected batches hold slots
+            # (releases are deferred to collection) — drain them first,
+            # then block for the oldest in-flight batch and retry.  No
+            # in-flight work at all means the buffered window alone
+            # exceeds capacity: list-buffer it.
+            drained = self.runner.collect_available()
+            for record in drained:
+                out.collect(record)
+            if drained:
+                continue
             if not self.runner._pending:
                 return None
             for record in self.runner.collect_ready(len(self.runner._pending) - 1):
@@ -393,7 +411,10 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
         tokens = [e for e in elements if isinstance(e, _RingToken)]
         if not tokens:
             return list(elements)
-        if self.runner is not None and self.runner._pending:
+        if self.runner is not None and (
+                self.runner._pending or self.runner.has_completed()):
+            # flush() also runs the deferred ring releases of completed
+            # batches, so the ring head is the buffer afterwards.
             for record in self.runner.flush():
                 if self._out is not None:
                     self._out.collect(record)
@@ -463,9 +484,10 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
                 # most once per trip around the ring).  Ring releases are
                 # strictly oldest-claim-first, so the immediate releases
                 # below would free a still-dispatched batch's slots if
-                # any were in flight — drain them first (their deferred
-                # on_done releases run FIFO), making our claim the oldest.
-                if self.runner._pending:
+                # any were in flight OR completed-but-uncollected — drain
+                # both (their deferred on_done releases run FIFO at
+                # collection), making our claim the oldest.
+                if self.runner._pending or self.runner.has_completed():
                     for record in self.runner.flush():
                         out.collect(record)
                 arrays = {f: np.empty((b, *v.shape[1:]), v.dtype)
@@ -502,7 +524,17 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
     # interval of its results landing, and the thread stays free to
     # accept arrivals and fire the next window meanwhile.
     def next_deadline(self) -> typing.Optional[float]:
-        if self.runner is None or not self.runner._pending or self._last_dispatch is None:
+        if self.runner is None:
+            return None
+        # Fetched results waiting: due IMMEDIATELY — 0.0 is in the past
+        # on the monotonic clock, so the caller's earlier `now` still
+        # satisfies `now >= deadline` (a fresh monotonic() here could
+        # exceed it and skip the fire).  The fetch thread also pokes the
+        # gate via on_results_ready, so the loop re-checks within one
+        # poll rather than one idle_flush interval.
+        if self.runner.has_completed():
+            return 0.0
+        if not self.runner._pending or self._last_dispatch is None:
             return None
         base = self._last_dispatch
         if self._last_poll is not None and self._last_poll > base:
