@@ -159,6 +159,11 @@ class DistributedExecutor(LocalExecutor):
         #: Control channels to peers (lazy; used only by the single
         #: persist worker thread).
         self._control_writers: typing.Dict[int, RemoteChannelWriter] = {}
+        #: Set once a durability announce reached EVERY peer — only then
+        #: is the gate's fast-fail connect cap safe (ADVICE r4: the
+        #: first checkpoint can race a peer's cold-compile-before-serve
+        #: window, and a capped connect would fail that gate spuriously).
+        self._gate_warmed = False
         try:
             super().__init__(graph, **kwargs)
         except BaseException:
@@ -249,13 +254,22 @@ class DistributedExecutor(LocalExecutor):
             writer = self._control_writers.get(p)
             if writer is None:
                 host, port = self.dist.endpoint(p)
-                # Short connect window: by the time checkpoints commit the
-                # cohort is long up — only a DYING peer is unreachable
-                # here, and the gate should fail fast, not wait out the
-                # cohort-startup grace period.
+                # Short connect window once the cohort is proven up (a
+                # prior announce reached every peer): from then on only a
+                # DYING peer is unreachable here, and the gate should
+                # fail fast, not wait out the cohort-startup grace
+                # period.  The FIRST gate keeps the full configured
+                # window — it can legitimately race a peer's cold XLA
+                # compile before its shuffle server answers (ADVICE r4:
+                # the unconditional 5s cap failed that gate spuriously
+                # and delayed the first 2PC commit by a checkpoint).
+                timeout_s = (
+                    min(5.0, self.dist.connect_timeout_s)
+                    if self._gate_warmed else self.dist.connect_timeout_s
+                )
                 writer = RemoteChannelWriter(
                     host, port, ShuffleServer.CONTROL_TASK, me, 0,
-                    connect_timeout_s=min(5.0, self.dist.connect_timeout_s),
+                    connect_timeout_s=timeout_s,
                 )
                 self._control_writers[p] = writer
             try:
@@ -266,6 +280,9 @@ class DistributedExecutor(LocalExecutor):
                     checkpoint_id, p, exc_info=True,
                 )
                 return False
+        # Every peer accepted an announcement: the cohort's servers are
+        # all provably up, so later gates may fail fast on connect.
+        self._gate_warmed = True
         deadline = time.monotonic() + self.checkpoint_timeout_s
         with self._durable_cv:
             try:

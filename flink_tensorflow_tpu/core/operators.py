@@ -548,9 +548,14 @@ class WindowOperator(_FunctionOperator):
             self._buffers[key] = buf
         value = record.value
         if self._arrival_stamp:
-            m = getattr(value, "meta", None)
-            if isinstance(m, dict):
-                m["__arrive_ts__"] = time.monotonic()
+            stamp = getattr(value, "with_meta", None)
+            if stamp is not None:
+                # Stamp onto a COPY of the record (ADVICE r4): the same
+                # record object may fan out to sibling operators or be
+                # retained by a sliding trigger, and an in-place meta
+                # mutation would be visible to those other consumers.
+                # The copy is shallow — frozen field arrays are shared.
+                value = stamp(__arrive_ts__=time.monotonic())
         # Zero-copy ingestion: tensor window functions may take the record
         # payload NOW (into their ring arena) and buffer only a token —
         # non-keyed only, and never for retaining (sliding) triggers:

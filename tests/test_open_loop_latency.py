@@ -235,7 +235,15 @@ class TestArrivalStamp:
         tv = TensorValue({"x": np.zeros((1,), np.float32)}, {"id": 1})
         before = time.monotonic()
         op.process_record(el.StreamRecord(tv))
-        assert before <= tv.meta["__arrive_ts__"] <= time.monotonic()
+        after = time.monotonic()
+        # The stamp lands on the BUFFERED copy; the input record object
+        # stays untouched — it may fan out to sibling operators or be
+        # retained by a sliding trigger (ADVICE r4).
+        assert "__arrive_ts__" not in tv.meta
+        (buf,) = op._buffers.values()
+        (stamped,) = buf.elements
+        assert before <= stamped.meta["__arrive_ts__"] <= after
+        assert stamped.meta["id"] == 1
 
     def test_no_stamp_without_opt_in(self):
         from flink_tensorflow_tpu.core import elements as el
