@@ -76,6 +76,22 @@ class CohortSupervisor:
     redistribution, validated against the participant set each shard
     recorded) carries the state across the shape change; no human
     relaunch, no state loss.
+
+    **Elastic scale-up** (``capacity_probe``): Flink's failover restores
+    the ORIGINAL parallelism when resources return (SURVEY.md §5); the
+    analogue here is the probe — a zero-arg callable reporting how many
+    workers are currently spawnable (slots seen by a scheduler, healthy
+    hosts on a heartbeat list, ...).  A shrunken cohort never interrupts
+    a healthy run to grow: at the next RESTART BOUNDARY (an attempt
+    failed anyway) the supervisor consults the probe and, if capacity
+    returned, re-forms at ``min(original, probe())`` with a fresh
+    budget; the same cohort-rescaling restore carries the state back up
+    (P-1 -> P).  A regrown shape that exhausts its own budget is barred
+    from future growth — otherwise a probe that keeps reporting a
+    flapping host back would oscillate P-1 <-> P forever instead of
+    converging down.  Without a probe, cohorts only shrink (the r4
+    behavior, kept as the default: the supervisor cannot know on its
+    own whether a lost host is coming back).
     """
 
     def __init__(
@@ -90,6 +106,7 @@ class CohortSupervisor:
         attempt_timeout_s: typing.Optional[float] = None,
         elastic: bool = False,
         min_workers: int = 1,
+        capacity_probe: typing.Optional[typing.Callable[[], int]] = None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -97,6 +114,8 @@ class CohortSupervisor:
             raise ValueError(
                 f"min_workers must be in [1, {num_workers}], got {min_workers}"
             )
+        if capacity_probe is not None and not elastic:
+            raise ValueError("capacity_probe requires elastic=True")
         self.command = command
         self.num_workers = num_workers
         self.env = env
@@ -106,6 +125,7 @@ class CohortSupervisor:
         self.attempt_timeout_s = attempt_timeout_s
         self.elastic = elastic
         self.min_workers = min_workers
+        self.capacity_probe = capacity_probe
 
     # -- one attempt -------------------------------------------------------
     def _spawn(self, attempt: int, num_workers: int) -> typing.List[subprocess.Popen]:
@@ -169,19 +189,60 @@ class CohortSupervisor:
         finally:
             self._kill_all(procs)
 
+    def _probe_capacity(self) -> int:
+        """Current spawnable-worker count per the operator-supplied
+        probe; 0 (never grow) without one or on probe failure."""
+        if self.capacity_probe is None:
+            return 0
+        try:
+            return int(self.capacity_probe())
+        except Exception:  # noqa: BLE001 - a broken probe must not kill recovery
+            logger.warning("capacity probe failed — not scaling up",
+                           exc_info=True)
+            return 0
+
     # -- public ------------------------------------------------------------
     def run(self) -> CohortOutcome:
         last_rc = -1
         shape = self.num_workers
         attempt = 0  # global, monotonic across shapes (port rotation etc.)
+        budget = self.max_restarts + 1  # fresh per shape change
+        barred: typing.Set[int] = set()  # shapes whose regrow budget failed
+        grown = False  # current shape was reached by scaling UP
         while True:
-            for _ in range(self.max_restarts + 1):
-                rc = self._run_attempt(attempt, shape)
-                attempt += 1
-                if rc == 0:
-                    return CohortOutcome(attempts=attempt, returncode=0,
-                                         num_workers=shape)
-                last_rc = rc
+            rc = self._run_attempt(attempt, shape)
+            attempt += 1
+            if rc == 0:
+                return CohortOutcome(attempts=attempt, returncode=0,
+                                     num_workers=shape)
+            last_rc = rc
+            budget -= 1
+            if budget <= 0 and grown:
+                # A regrown shape that exhausted its own budget is ruled
+                # out for good: without the bar, a probe that keeps
+                # reporting a flapping host back would oscillate
+                # P-1 <-> P forever instead of converging down.
+                barred.add(shape)
+            # Scale-up leg (restart boundary): a shrunken cohort grows
+            # back toward the original shape when capacity returned.
+            # The same cohort-rescaling restore that shrank the state
+            # carries it back up.
+            if self.elastic and shape < self.num_workers:
+                target = min(self.num_workers, self._probe_capacity())
+                while target > shape and target in barred:
+                    target -= 1
+                if target > shape:
+                    logger.warning(
+                        "capacity returned (%d workers available) — "
+                        "re-forming the cohort elastically at %d "
+                        "(was %d)", target, target, shape,
+                    )
+                    shape = target
+                    budget = self.max_restarts + 1
+                    grown = True
+                    continue
+            if budget > 0:
+                continue
             if self.elastic and shape > self.min_workers:
                 # Respawn budget exhausted at this shape: treat it as
                 # permanent worker loss and re-form one smaller with a
@@ -192,6 +253,8 @@ class CohortSupervisor:
                     "the cohort elastically at %d", shape, shape - 1,
                 )
                 shape -= 1
+                budget = self.max_restarts + 1
+                grown = False
                 continue
             raise CohortFailed(attempt, last_rc)
 

