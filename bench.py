@@ -443,7 +443,12 @@ def _train_compute_probe(dev, *, smoke: bool = False) -> dict:
         state, witness = loop(state, image, label, k, jnp.int32(salt_ctr[0]))
         return float(witness)
 
-    k1, k2 = (1, 3) if smoke else (2, 8)
+    # k2=16 (was 8): the r4 probe swung 28-34% across same-day runs
+    # (VERDICT r4 weak #2) because the k2-k1 spread amortized too little
+    # of the call RTT variance (±100ms on ~6 steps of ~50ms).  Doubling
+    # the spread halves the variance contribution per step; the
+    # --mfu-attribution trace (pure device_duration_ps) cross-checks it.
+    k1, k2 = (1, 3) if smoke else (2, 16)
     run_once(k1)  # compile + residency
     per_step_s, degenerate, k2 = _delta_timing(
         run_once, k1, k2, widen_once=not smoke)
@@ -1124,7 +1129,288 @@ def bench_inception(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# workload 2: MNIST LeNet windowed micro-batch inference
+# MFU attribution (VERDICT r4 #3): per-fusion device timing via the XLA
+# profiler.  The trace's `device_duration_ps` is measured ON THE CHIP, so
+# the attribution is transport-immune — the tunnel's RTT/bandwidth games
+# cannot touch it (cross-checked: a 2048^3 bf16 matmul fusion shows
+# 17.18 GFLOP / 89.8us = 191 TFLOP/s = 97% of the v5e's 197 peak).
+# ---------------------------------------------------------------------------
+
+# HBM bandwidth by device kind, GB/s — for the roofline verdict per
+# fusion category (a category at high GB/s and low TFLOP/s is
+# bandwidth-bound, not MXU-starved).
+CHIP_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,   # v5e
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,  # v6e / Trillium
+    "TPU v6e": 1640.0,
+}
+
+
+def _parse_xla_trace(trace: dict, module_prefix: str,
+                     peak_tflops=None, hbm_gbps=None) -> dict:
+    """Aggregate a jax-profiler chrome trace into per-HLO-category device
+    timing for the module whose jitted name starts with ``module_prefix``.
+
+    Pure function over the loaded ``trace.json`` dict (unit-testable
+    without hardware).  Device events are identified by the
+    ``/device:``-named process and their ``device_duration_ps`` arg; the
+    module's own event (``jit_<prefix>...``) gives the per-execution
+    wall, and child fusion events are attributed to the LAST complete
+    execution via its device-time window (children share no run id with
+    the parent in the chrome export, but they nest inside its
+    [offset, offset+duration) span).
+
+    Each fusion category row carries time share, FLOPs, achieved
+    TFLOP/s, bytes accessed, achieved GB/s, and a roofline verdict
+    against the chip peaks.
+    """
+    events = trace.get("traceEvents", [])
+    dev_pids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "/device:" in str(e.get("args", {}).get("name", ""))
+    }
+    dev = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("pid") in dev_pids
+        and "device_duration_ps" in e.get("args", {})
+    ]
+    if not dev:
+        return {"attribution_unavailable":
+                "no device-side trace events (CPU backend or profiler "
+                "did not relay device timing)"}
+    module_evts = sorted(
+        (e for e in dev if str(e.get("name", "")).startswith(
+            f"jit_{module_prefix}")),
+        key=lambda e: int(e["args"]["device_offset_ps"]),
+    )
+    if not module_evts:
+        return {"attribution_unavailable":
+                f"no jit_{module_prefix}* module event in device trace"}
+    last = module_evts[-1]
+    t0 = int(last["args"]["device_offset_ps"])
+    t1 = t0 + int(last["args"]["device_duration_ps"])
+    window = [
+        e for e in dev
+        if e is not last
+        and t0 <= int(e["args"]["device_offset_ps"]) < t1
+        and "hlo_category" in e["args"]
+    ]
+    cats: dict = {}
+    for e in window:
+        a = e["args"]
+        c = cats.setdefault(a["hlo_category"], {
+            "ops": 0, "time_ps": 0, "flops": 0.0, "bytes": 0.0})
+        c["ops"] += 1
+        c["time_ps"] += int(a["device_duration_ps"])
+        c["flops"] += float(a.get("model_flops", 0) or 0)
+        c["bytes"] += float(a.get("raw_bytes_accessed",
+                                  a.get("bytes_accessed", 0)) or 0)
+    total_ps = t1 - t0
+    accounted_ps = sum(c["time_ps"] for c in cats.values())
+    rows = []
+    for name, c in sorted(cats.items(), key=lambda kv: -kv[1]["time_ps"]):
+        secs = c["time_ps"] * 1e-12
+        tf = c["flops"] / secs / 1e12 if secs > 0 else None
+        gbs = c["bytes"] / secs / 1e9 if secs > 0 else None
+        share = 100.0 * c["time_ps"] / total_ps
+        if share < 0.5:
+            # copy-start/async-done events carry the bytes of transfers
+            # whose actual duration overlaps other work; their implied
+            # GB/s is meaningless (measured: "160 TB/s"), so no roofline
+            # verdict for rows that cost no time.
+            bound = "negligible (<0.5% of device time)"
+        elif tf is not None and peak_tflops and tf > 0.5 * peak_tflops:
+            bound = "MXU-bound"
+        elif gbs is not None and hbm_gbps and gbs > 0.5 * hbm_gbps:
+            bound = "HBM-bandwidth-bound"
+        elif c["flops"] > 0:
+            bound = "under-utilized (small tiles / low occupancy)"
+        else:
+            bound = "non-FLOP overhead"
+        rows.append({
+            "category": name,
+            "ops": c["ops"],
+            "time_ms": round(c["time_ps"] * 1e-9, 3),
+            "time_share_pct": round(100.0 * c["time_ps"] / total_ps, 1),
+            "gflops": round(c["flops"] / 1e9, 2),
+            "achieved_tflops": round(tf, 2) if tf is not None else None,
+            "mfu_pct": (round(100.0 * tf / peak_tflops, 1)
+                        if tf is not None and peak_tflops else None),
+            "achieved_gb_s": round(gbs, 1) if gbs is not None else None,
+            "hbm_util_pct": (round(100.0 * gbs / hbm_gbps, 1)
+                             if gbs is not None and hbm_gbps else None),
+            "verdict": bound,
+        })
+    module_s = total_ps * 1e-12
+    module_flops = sum(c["flops"] for c in cats.values())
+    return {
+        "module": last.get("name"),
+        "executions_traced": len(module_evts),
+        "device_time_ms": round(total_ps * 1e-9, 3),
+        "accounted_time_pct": round(100.0 * accounted_ps / total_ps, 1),
+        "module_gflops": round(module_flops / 1e9, 2),
+        "module_achieved_tflops": (
+            round(module_flops / module_s / 1e12, 2) if module_s > 0 else None),
+        "module_mfu_pct": (
+            round(100.0 * module_flops / module_s / 1e12 / peak_tflops, 1)
+            if module_s > 0 and peak_tflops else None),
+        "by_category": rows,
+    }
+
+
+def _traced_attribution(fn_name: str, run_salted, dev, *, calls: int = 3) -> dict:
+    """Run ``run_salted(i)`` (which must host-fetch a salt-dependent
+    value) ``calls`` times under the jax profiler and parse the device
+    trace.  The trace is captured to a throwaway dir; parsing happens
+    immediately so nothing large persists."""
+    import glob
+    import gzip
+    import tempfile
+
+    import jax
+
+    peak = _chip_peak_tflops(dev)
+    hbm = CHIP_HBM_GBPS.get(getattr(dev, "device_kind", ""), None)
+    with tempfile.TemporaryDirectory(prefix="mfu_trace_") as d:
+        with jax.profiler.trace(d):
+            for i in range(calls):
+                run_salted(i)
+        paths = glob.glob(d + "/plugins/profile/*/*.trace.json.gz")
+        if not paths:
+            return {"attribution_unavailable": "profiler produced no trace"}
+        with gzip.open(paths[0]) as f:
+            trace = json.load(f)
+    return _parse_xla_trace(trace, fn_name, peak_tflops=peak, hbm_gbps=hbm)
+
+
+def bench_mfu_attribution(args) -> dict:
+    """Per-fusion attribution of the MFU plateau (VERDICT r4 #3):
+    Inception-v3 forward at the sweep's best batch, the ResNet-50 train
+    step at the flagship batch, and the targeted experiment — the train
+    step at DOUBLE batch (does the plateau move?).  All inputs are
+    generated on device and salted per call; every timed quantity is
+    device-side (``device_duration_ps``), so the numbers are immune to
+    the tunnel's RTT variance, readiness early-acks, and result caching
+    (the salt makes each dispatch distinct; the host fetch forces real
+    execution)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from flink_tensorflow_tpu.models import get_model_def
+    from flink_tensorflow_tpu.parallel.dp import init_train_state, make_train_step
+
+    dev = jax.devices()[0]
+    out = {
+        "metric": "mfu_attribution",
+        "value": None,
+        "unit": "per-fusion device timing",
+        "vs_baseline": None,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "chip_peak_bf16_tflops": _chip_peak_tflops(dev),
+        "chip_hbm_gb_s": CHIP_HBM_GBPS.get(
+            getattr(dev, "device_kind", ""), None),
+    }
+
+    # --- Inception-v3 forward ------------------------------------------
+    b = 8 if args.smoke else 512
+    mdef = get_model_def("inception_v3", num_classes=10 if args.smoke else 1000,
+                         uint8_input=True)
+    model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+    serve = model.method("serve").fn
+    params = jax.device_put(model.params, dev)
+    x = jax.jit(
+        lambda k: jax.random.randint(
+            k, (b, 299, 299, 3), 0, 256, dtype=jnp.int32).astype(jnp.uint8)
+    )(jax.random.key(7))
+
+    def fwd(p, xx, salt):
+        xi = jnp.bitwise_xor(xx, salt.astype(jnp.uint8))
+        return serve(p, {"image": xi})["score"].sum()
+
+    fwd_jit = jax.jit(fwd)
+    float(fwd_jit(params, x, jnp.int32(1)))  # compile outside the trace
+    out["inception_fwd"] = {
+        "batch": b,
+        **_traced_attribution(
+            "fwd", lambda i: float(fwd_jit(params, x, jnp.int32(100 + i))),
+            dev),
+    }
+
+    # --- ResNet-50 train step at flagship batch + 2x experiment --------
+    def train_attrib(tb: int) -> dict:
+        if args.smoke:
+            size, classes = 32, 10
+            m = get_model_def("resnet50", num_classes=classes, image_size=size,
+                              width=8, stage_sizes=(1, 1), uint8_input=True)
+        else:
+            size, classes = 224, 1000
+            m = get_model_def("resnet50", num_classes=classes, image_size=size,
+                              uint8_input=True)
+        opt = optax.sgd(0.1, momentum=0.9)
+        state = jax.device_put(init_train_state(m, opt, jax.random.key(0)), dev)
+        step = make_train_step(m, opt)
+        image = jax.jit(
+            lambda k: jax.random.randint(
+                k, (tb, size, size, 3), 0, 256, dtype=jnp.int32
+            ).astype(jnp.uint8))(jax.random.key(1))
+        label = jax.jit(
+            lambda k: jax.random.randint(k, (tb,), 0, classes, dtype=jnp.int32)
+        )(jax.random.key(2))
+
+        def tstep(st, xx, yy, salt):
+            xi = jnp.bitwise_xor(xx, salt.astype(jnp.uint8))
+            st2, metrics = step(st, {"image": xi, "label": yy})
+            return st2, metrics["loss"]
+
+        tstep_jit = jax.jit(tstep, donate_argnums=(0,))
+        holder = {"state": state}
+
+        def run(i):
+            holder["state"], loss = tstep_jit(
+                holder["state"], image, label, jnp.int32(100 + i))
+            return float(loss)  # host fetch: forces real execution
+
+        run(0)  # compile outside the trace
+        result = {"batch": tb,
+                  **_traced_attribution("tstep", run, dev)}
+        holder.clear()
+        return result
+
+    base_b = 8 if args.smoke else 128
+    out["resnet50_train"] = train_attrib(base_b)
+    # The targeted experiment: does doubling the batch move the train
+    # MFU (tile amortization), or is the plateau architectural?
+    out["resnet50_train_2x"] = train_attrib(2 * base_b)
+    verdict = _experiment_verdict(
+        out["resnet50_train"].get("module_mfu_pct"),
+        out["resnet50_train_2x"].get("module_mfu_pct"),
+        base_b, 2 * base_b)
+    if verdict is not None:
+        out["experiment_verdict"] = verdict
+    out["value"] = out["inception_fwd"].get("module_mfu_pct")
+    return out
+
+
+def _experiment_verdict(m0, m1, b0: int, b1: int) -> typing.Optional[str]:
+    """Verdict of the 2x-batch experiment.  ``is not None`` checks, not
+    truthiness: an MFU that rounds to 0.0 is a real measurement and the
+    verdict — the question the probe exists to answer — must still be
+    emitted."""
+    if m0 is None or m1 is None:
+        return None
+    moved = m0 > 0 and m1 > 1.15 * m0
+    return (
+        f"train-step MFU {m0}% at b={b0} -> {m1}% at b={b1}: "
+        + ("batch size moves it — the plateau is occupancy, not "
+           "architecture" if moved else
+           "flat within ~15% — the plateau is architectural for this "
+           "model on this chip, not a batch-size artifact")
+    )
 # ---------------------------------------------------------------------------
 
 def bench_mnist(args) -> dict:
@@ -1430,6 +1716,10 @@ def main(argv=None):
     p.add_argument("--open-loop-start-delay-s", type=float, default=60.0,
                    help="shift the open-loop schedule past pipeline warmup "
                         "(covers one cold XLA compile of the service bucket)")
+    p.add_argument("--mfu-attribution", action="store_true",
+                   help="run ONLY the per-fusion MFU attribution (device-"
+                        "side XLA profiler timing; writes "
+                        "MFU_ATTRIBUTION.json)")
     args = p.parse_args(argv)
 
     from flink_tensorflow_tpu.utils.platform import enable_compile_cache, force_cpu
@@ -1444,6 +1734,35 @@ def main(argv=None):
     # Persistent XLA compile cache: repeat bench runs (and the driver's)
     # skip the one-time model compiles entirely.
     enable_compile_cache()
+
+    if args.mfu_attribution:
+        out = _json_safe(bench_mfu_attribution(args))
+        line = json.dumps(out, allow_nan=False)
+        print(line, flush=True)
+        wrote = False
+        try:
+            with open(MFU_ATTRIBUTION_PATH, "w") as f:
+                f.write(line + "\n")
+            wrote = True
+        except OSError:
+            pass
+        # Same final-line contract as the workload path: the ~9.6KB full
+        # dict above would overflow the driver's tail capture, so the
+        # LAST line is a compact digest.
+        digest = {
+            "scoreboard": True,
+            "metric": "mfu_attribution",
+            "inception_fwd_mfu_pct": (out.get("inception_fwd") or {}).get(
+                "module_mfu_pct"),
+            "resnet50_train_mfu_pct": (out.get("resnet50_train") or {}).get(
+                "module_mfu_pct"),
+            "resnet50_train_2x_mfu_pct": (
+                out.get("resnet50_train_2x") or {}).get("module_mfu_pct"),
+            "experiment_verdict": out.get("experiment_verdict"),
+            "full_detail": "MFU_ATTRIBUTION.json" if wrote else None,
+        }
+        print(json.dumps(_json_safe(digest), allow_nan=False), flush=True)
+        return out
 
     names = list(WORKLOADS) if args.workload == "all" else [args.workload]
     outputs = []
@@ -1488,6 +1807,9 @@ SCOREBOARD_MAX_BYTES = 1500
 # Full per-workload detail lands here; the scoreboard points at it.
 BENCH_FULL_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_full.json")
+# Full per-fusion attribution lands here (--mfu-attribution mode).
+MFU_ATTRIBUTION_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "MFU_ATTRIBUTION.json")
 
 
 def _scoreboard(outputs: list) -> dict:
