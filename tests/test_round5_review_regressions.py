@@ -557,6 +557,19 @@ class TestBackgroundFetch:
         assert v is not None and "flat within" in v
         assert bench._experiment_verdict(None, 31.4, 128, 256) is None
         assert "moves it" in bench._experiment_verdict(20.0, 25.0, 128, 256)
+        # m0 == 0.0 with a nonzero m1 IS a move — a positivity guard on
+        # m0 would force every zero-base run to read "flat".
+        assert "moves it" in bench._experiment_verdict(0.0, 0.3, 8, 16)
+
+    def test_hbm_table_uses_prefix_match(self):
+        """An exact .get on device_kind killed the HBM-bandwidth-bound
+        verdict for suffixed kind strings; both chip tables go through
+        the same longest-prefix matcher."""
+        class _Dev:
+            device_kind = "TPU v5 lite (something new)"
+
+        assert bench._chip_table_lookup(_Dev(), bench.CHIP_HBM_GBPS) == 819.0
+        assert bench._chip_peak_tflops(_Dev()) == 197.0
 
     def test_gate_wake_breaks_poll_sleep(self):
         """InputGate.wake() returns a blocked poll immediately, losing
