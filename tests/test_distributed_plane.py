@@ -676,7 +676,7 @@ class TestElasticCohort:
             if num_workers == 3 and w == 2 and attempt > 0:
                 # The lost worker's host is GONE: every same-shape
                 # respawn of worker 2 fails immediately.
-                return [sys.executable, "-c", "import sys; sys.exit(7)"]
+                return [sys.executable, "-S", "-c", "import sys; sys.exit(7)"]
             cmd = [sys.executable, worker, "--index", str(w),
                    "--ports", ",".join(map(str, ports_by_shape[num_workers])),
                    "--out", out, "--chk", chk,
@@ -730,7 +730,7 @@ class TestElasticCohort:
             if num_workers == 3 and w == 2 and attempt == 1:
                 # Worker 2's host is down for the same-shape respawn:
                 # the shape-3 budget burns and the cohort shrinks.
-                return [sys.executable, "-c", "import sys; sys.exit(7)"]
+                return [sys.executable, "-S", "-c", "import sys; sys.exit(7)"]
             cmd = [sys.executable, worker, "--index", str(w),
                    "--ports", ",".join(map(str, ports_by_shape[num_workers])),
                    "--out", out, "--chk", chk,
@@ -777,11 +777,11 @@ class TestElasticCohort:
         def command(w, num_workers, attempt):
             if num_workers == 3:
                 # Shape 3 never survives (initial run AND the regrow).
-                return [sys.executable, "-c", "import sys; sys.exit(3)"]
+                return [sys.executable, "-S", "-c", "import sys; sys.exit(3)"]
             # Shape 2: fails once (the boundary that triggers the
             # regrow), succeeds after the barred shape falls back.
             rc = 1 if attempt == 2 else 0
-            return [sys.executable, "-c", f"import sys; sys.exit({rc})"]
+            return [sys.executable, "-S", "-c", f"import sys; sys.exit({rc})"]
 
         sup = CohortSupervisor(
             command, 3, max_restarts=1, poll_s=0.02,
