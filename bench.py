@@ -554,6 +554,54 @@ def _steps_per_sec(arrivals, steps):
     return (steps - 1) / span if span > 0 else float("nan")
 
 
+def _attach_wire_consistency(out: dict, wire_pre: dict, wire_post: dict,
+                             record_bytes, rps, *, bytes_source: str) -> dict:
+    """Attach the flagship's physical-consistency evidence to a
+    secondary workload line (VERDICT r4 #4: all five workloads carry a
+    wire bracket and a bottleneck verdict, not just Inception): the
+    pass's sustained-MB/s bracket, the implied per-record ceiling
+    range, achieved-rate efficiency against the UPPER bracket, and the
+    verdict.  ``record_bytes`` is measured (h2d counter / records)
+    where the operator tracks it, analytic (schema bytes) otherwise —
+    ``bytes_source`` says which, so the two are never conflated."""
+    out["wire_sustained_mb_s_bracket"] = [
+        wire_pre.get("sustained_mb_s"), wire_post.get("sustained_mb_s")]
+    # NaN rps is truthy — guard it explicitly (a 1-step run's NaN
+    # steps/s would otherwise emit a NaN efficiency, breaking the
+    # strict-JSON line contract, plus a verdict derived from NaN
+    # comparisons).
+    if not record_bytes or not rps or rps != rps:
+        return out
+    ceilings = [
+        w["sustained_mb_s"] * 1e6 / record_bytes
+        for w in (wire_pre, wire_post)
+        if w.get("sustained_mb_s")
+    ]
+    if not ceilings:
+        return out
+    lo, hi = min(ceilings), max(ceilings)
+    out["record_bytes"] = int(record_bytes)
+    out["record_bytes_source"] = bytes_source
+    out["wire_ceiling_records_per_sec_range"] = [round(lo, 1), round(hi, 1)]
+    out["efficiency_vs_wire_ceiling"] = round(rps / hi, 3)
+    # Same drift semantics as the flagship: an achieved rate above BOTH
+    # bracketing probes must carry an annotation, never masquerade as
+    # >100% efficiency (content dedup or a mid-pass bandwidth jump).
+    out["ceiling_drift_code"] = (
+        None if rps <= hi
+        else "unreliable" if rps > 1.05 * hi
+        else "marginal<=5%"
+    )
+    if out["ceiling_drift_code"] is not None:
+        out["ceiling_drift"] = CEILING_DRIFT_PROSE[out["ceiling_drift_code"]]
+    out["bottleneck"] = (
+        "host->device wire bandwidth of the tunnel-attached device"
+        if rps >= 0.7 * lo else
+        "device compute / per-dispatch round trips (wire not saturated)"
+    )
+    return out
+
+
 def _percentiles_ms(latencies_s):
     if not latencies_s:
         return float("nan"), float("nan")
@@ -1451,10 +1499,19 @@ def bench_mnist(args) -> dict:
     mdef = get_model_def("lenet")
     model = mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
     rng = np.random.RandomState(0)
-    base = [rng.rand(28, 28, 1).astype(np.float32) for _ in range(batch)]
-    records = [TensorValue({"image": base[i % batch]}, {"id": i})
+    # EVERY record carries unique bytes (same rule as the flagship): the
+    # r3/r4 runs recycled `batch` base images, making consecutive
+    # windows byte-identical on the wire — and the tunnel dedupes
+    # repeated content, so those runs could ride a cache past the wire
+    # ceiling (the r5 recycled-pool run measured 2,026 rec/s against a
+    # ~1,900 rec/s bracket).  51MB pool, rows shared read-only.
+    pool = rng.rand(records_n, 28, 28, 1).astype(np.float32)
+    pool.setflags(write=False)
+    records = [TensorValue({"image": pool[i]}, {"id": i})
                for i in range(records_n)]
 
+    dev = jax.devices()[0]
+    wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
     env = StreamExecutionEnvironment(parallelism=1)
     sink, results, arrivals = _timed_sink()
     (
@@ -1473,11 +1530,12 @@ def bench_mnist(args) -> dict:
         .sink_to_callable(sink)
     )
     job = env.execute("bench-mnist-lenet", timeout=3600)
+    wire_post = _wire_probe(dev, smoke=args.smoke, micro=True)
     assert len(results) == records_n
     n_chips = len(jax.devices())
     rps_per_chip, _ = _steady_rps(arrivals, records_n, batch, n_chips)
     lat = job.metrics.get("lenet.0.record_latency_s", {})
-    return {
+    out = {
         "metric": "mnist_lenet_microbatch_records_per_sec_per_chip",
         "value": round(rps_per_chip, 2),
         "unit": "records/s/chip",
@@ -1489,6 +1547,10 @@ def bench_mnist(args) -> dict:
         "platform": jax.devices()[0].platform,
         "baseline_note": "reference published no numbers for this workload",
     }
+    return _attach_wire_consistency(
+        out, wire_pre, wire_post,
+        job.metrics.get("lenet.0.h2d_bytes", 0) / records_n,
+        rps_per_chip * n_chips, bytes_source="measured_h2d/records")
 
 
 # ---------------------------------------------------------------------------
@@ -1517,6 +1579,8 @@ def bench_bilstm(args) -> dict:
             {"id": i, "length": length},
         ))
 
+    dev = jax.devices()[0]
+    wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
     env = StreamExecutionEnvironment(parallelism=1)
     sink, results, arrivals = _timed_sink()
     (
@@ -1535,11 +1599,12 @@ def bench_bilstm(args) -> dict:
         .sink_to_callable(sink)
     )
     job = env.execute("bench-bilstm", timeout=3600)
+    wire_post = _wire_probe(dev, smoke=args.smoke, micro=True)
     assert len(results) == records_n
     n_chips = len(jax.devices())
     rps_per_chip, _ = _steady_rps(arrivals, records_n, batch, n_chips)
     lat = job.metrics.get("bilstm.0.record_latency_s", {})
-    return {
+    out = {
         "metric": "bilstm_streaming_inference_records_per_sec_per_chip",
         "value": round(rps_per_chip, 2),
         "unit": "records/s/chip",
@@ -1552,6 +1617,12 @@ def bench_bilstm(args) -> dict:
         "platform": jax.devices()[0].platform,
         "baseline_note": "reference published no numbers for this workload",
     }
+    # Measured bytes include bucket padding (dynamic lengths pad to the
+    # ladder) — the true wire cost per record, not the token count.
+    return _attach_wire_consistency(
+        out, wire_pre, wire_post,
+        job.metrics.get("bilstm.0.h2d_bytes", 0) / records_n,
+        rps_per_chip * n_chips, bytes_source="measured_h2d/records")
 
 
 # ---------------------------------------------------------------------------
@@ -1590,6 +1661,8 @@ def bench_widedeep(args) -> dict:
             "label": np.int32(x_wide[user % cfg["num_wide"]] > 0.5),
         }, meta={"user": user}))
 
+    dev = jax.devices()[0]
+    wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
     env = StreamExecutionEnvironment(parallelism=1)
     sink, results, arrivals = _timed_sink()
     (
@@ -1607,12 +1680,14 @@ def bench_widedeep(args) -> dict:
         .sink_to_callable(sink)
     )
     job = env.execute("bench-widedeep-online", timeout=3600)
+    wire_post = _wire_probe(dev, smoke=args.smoke, micro=True)
     n_chips = len(jax.devices())
     steps = len(results)
     steps_per_s = _steps_per_sec(arrivals, steps)
     losses = [float(r["loss"]) for r in results]
     k = max(1, len(losses) // 5)
-    return {
+    record_bytes = sum(a.nbytes for a in records[0].fields.values())
+    out = {
         "metric": "widedeep_online_training_steps_per_sec",
         "value": round(steps_per_s, 2),
         "unit": "steps/s",
@@ -1628,6 +1703,12 @@ def bench_widedeep(args) -> dict:
         "platform": jax.devices()[0].platform,
         "baseline_note": "reference published no numbers for this workload",
     }
+    # 116B records: the wire ceiling is ~50k rec/s even on a slow phase,
+    # so the expected verdict is per-dispatch-round-trip-bound — which
+    # is exactly what steps_per_dispatch=16 amortizes.
+    return _attach_wire_consistency(
+        out, wire_pre, wire_post, record_bytes,
+        steps_per_s * mini_batch, bytes_source="schema_bytes")
 
 
 # ---------------------------------------------------------------------------
@@ -1670,6 +1751,8 @@ def bench_resnet(args) -> dict:
     schema = RecordSchema({"image": spec((size, size, 3), np.uint8),
                            "label": spec((), np.int32)})
 
+    dev = jax.devices()[0]
+    wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
     env = StreamExecutionEnvironment(parallelism=1)
     env.set_mesh(mesh)
     sink, results, arrivals = _timed_sink()
@@ -1682,11 +1765,13 @@ def bench_resnet(args) -> dict:
         .sink_to_callable(sink)
     )
     job = env.execute("bench-resnet-dp", timeout=7200)
+    wire_post = _wire_probe(dev, smoke=args.smoke, micro=True)
     steps = len(results)
     steps_per_s = _steps_per_sec(arrivals, steps)
     rps = steps_per_s * batch
     losses = [float(r["loss"]) for r in results]
-    return {
+    record_bytes = sum(a.nbytes for a in records[0].fields.values())
+    out = {
         "metric": "resnet50_dp_training_records_per_sec_per_chip",
         "value": round(rps / max(1, n_dev), 2),
         "unit": "records/s/chip",
@@ -1702,6 +1787,9 @@ def bench_resnet(args) -> dict:
         "platform": jax.devices()[0].platform,
         "baseline_note": "reference published no numbers for this workload",
     }
+    return _attach_wire_consistency(
+        out, wire_pre, wire_post, record_bytes, rps,
+        bytes_source="schema_bytes")
 
 
 WORKLOADS = {

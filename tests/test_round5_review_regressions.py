@@ -561,6 +561,49 @@ class TestBackgroundFetch:
         # m0 would force every zero-base run to read "flat".
         assert "moves it" in bench._experiment_verdict(0.0, 0.3, 8, 16)
 
+    def test_secondary_workload_consistency_fields(self):
+        """VERDICT r4 #4: secondary workload lines carry the same wire
+        bracket / ceiling / efficiency / bottleneck evidence as the
+        flagship."""
+        out = bench._attach_wire_consistency(
+            {"value": 1800.0}, {"sustained_mb_s": 6.0},
+            {"sustained_mb_s": 5.0}, 3136, 1800.0,
+            bytes_source="measured_h2d/records")
+        assert out["wire_sustained_mb_s_bracket"] == [6.0, 5.0]
+        lo, hi = out["wire_ceiling_records_per_sec_range"]
+        assert lo == round(5.0e6 / 3136, 1) and hi == round(6.0e6 / 3136, 1)
+        assert out["efficiency_vs_wire_ceiling"] == round(1800.0 / hi, 3)
+        assert out["bottleneck"].startswith("host->device wire")
+        # Far below the ceiling: the verdict flips to compute/RTT-bound.
+        out2 = bench._attach_wire_consistency(
+            {"value": 100.0}, {"sustained_mb_s": 6.0},
+            {"sustained_mb_s": 5.0}, 116, 100.0, bytes_source="schema_bytes")
+        assert out2["bottleneck"].startswith("device compute")
+        # Degenerate probes degrade gracefully (no ceiling fields).
+        out3 = bench._attach_wire_consistency(
+            {"value": 1.0}, {"sustained_mb_s": None},
+            {"sustained_mb_s": None}, 100, 1.0, bytes_source="schema_bytes")
+        assert "wire_ceiling_records_per_sec_range" not in out3
+        # NaN rates (1-step runs) must not emit NaN efficiency.
+        out4 = bench._attach_wire_consistency(
+            {"value": None}, {"sustained_mb_s": 6.0},
+            {"sustained_mb_s": 5.0}, 100, float("nan"),
+            bytes_source="schema_bytes")
+        assert "efficiency_vs_wire_ceiling" not in out4
+        # A rate above BOTH brackets carries the drift annotation —
+        # never a silent >1.0 efficiency (tunnel content dedup).
+        out5 = bench._attach_wire_consistency(
+            {"value": 2026.0}, {"sustained_mb_s": 6.0},
+            {"sustained_mb_s": 5.0}, 3136, 2026.0,
+            bytes_source="measured_h2d/records")
+        assert out5["efficiency_vs_wire_ceiling"] > 1.0
+        assert out5["ceiling_drift_code"] == "unreliable"
+        in_band = bench._attach_wire_consistency(
+            {"value": 1000.0}, {"sustained_mb_s": 6.0},
+            {"sustained_mb_s": 5.0}, 3136, 1000.0,
+            bytes_source="measured_h2d/records")
+        assert in_band["ceiling_drift_code"] is None
+
     def test_hbm_table_uses_prefix_match(self):
         """An exact .get on device_kind killed the HBM-bandwidth-bound
         verdict for suffixed kind strings; both chip tables go through
