@@ -1,4 +1,4 @@
-"""Round-5 regression pins (VERDICT r4 #1/#2/#6 + ADVICE r4).
+"""Round-5 regression pins (VERDICT r4 #1/#2/#3/#4/#6 + ADVICE r4).
 
 Each test pins a defect found in the round-5 adversarial sweep over the
 round-4 surface, or a contract the final round's auditability depends
@@ -9,17 +9,30 @@ on:
    so the round's headline driver-run numbers were LOST.  bench.py now
    prints a compact scoreboard as the FINAL stdout line (full detail to
    earlier lines + BENCH_full.json); the scoreboard must stay under the
-   tail window whatever fields future edits add.
+   tail window whatever fields future edits add — and the same contract
+   covers ``--mfu-attribution`` and write-failure honesty (a stale
+   artifact is never advertised as current).
 2. The open-loop fetch serialized a full transport round trip per
    window AFTER readiness (VERDICT r4 weak #1: fetch p50 110.9ms ≈ the
    93.3ms call RTT), and the tunnel can ack ``is_ready`` before
    completion, making readiness-gated fetches block arbitrarily.  The
    runner now fetches on a dedicated background thread (no readiness
-   consulted — a blocking fetch IS completion) and defers ring releases
-   to the collecting thread (the TensorRing is SPSC).
+   consulted — a blocking fetch IS completion), defers ring releases
+   to the collecting thread (the TensorRing is SPSC), wakes the
+   subtask loop on completion (InputGate.wake), and a completion wake
+   must NOT flush the async map's partial micro-batch.
 3. The per-batch ``__stages__`` stamp was ONE dict shared by every
    record of the batch (VERDICT r4 weak #5): mutating one record's
    stamps mutated its siblings'.
+4. MFU attribution (VERDICT r4 #3): the trace parser aggregates only
+   device-side events inside the module window, classifies categories
+   by roofline, resolves chip tables by longest prefix, and the
+   2x-batch experiment verdict survives zero-valued measurements.
+5. Workload physical consistency (VERDICT r4 #4): secondary workload
+   lines carry wire brackets/ceilings/efficiency/drift/bottleneck with
+   flagship semantics (no silent >1.0 efficiency, no NaN emission).
+6. ADVICE r4: the durability gate's fast-fail connect cap arms only
+   after the first cohort-wide exchange proves every peer up.
 """
 
 import json
