@@ -627,6 +627,43 @@ class TestBackgroundFetch:
         assert bench._chip_table_lookup(_Dev(), bench.CHIP_HBM_GBPS) == 819.0
         assert bench._chip_peak_tflops(_Dev()) == 197.0
 
+    def test_fetch_thread_stress_fifo_and_completeness(self):
+        """Concurrency shakeout for the fetch-thread path: many small
+        batches through both lane modes with a mixed, randomly-timed
+        collect pattern (available/ready/progress/defer) must deliver
+        every record exactly once, in dispatch order, with nothing left
+        pending — and close() must not deadlock regardless of where the
+        pattern stopped."""
+        import random
+
+        rng = random.Random(7)
+        for lanes in (1, 3):
+            r = _lenet_runner(dispatch_lanes=lanes)
+            try:
+                total = 120
+                recs = _recs(total)
+                out = []
+                i = 0
+                while i < total:
+                    n = rng.choice((1, 2, 3))
+                    r.dispatch(recs[i:i + n])
+                    i += n
+                    mode = rng.random()
+                    if mode < 0.35:
+                        out.extend(r.collect_available())
+                    elif mode < 0.6:
+                        out.extend(r.collect_ready(rng.choice((1, 2, 4))))
+                    elif mode < 0.8:
+                        out.extend(r.collect_progress(rng.choice((1, 2, 4))))
+                    # else: defer — let batches pile up for later modes
+                    if rng.random() < 0.2:
+                        time.sleep(0.002)
+                out.extend(r.flush())
+                assert [v.meta["id"] for v in out] == list(range(total))
+                assert not r._pending and not r.has_completed()
+            finally:
+                r.close()
+
     def test_gate_wake_breaks_poll_sleep(self):
         """InputGate.wake() returns a blocked poll immediately, losing
         no stream elements."""
