@@ -974,224 +974,286 @@ def bench_inception(args) -> dict:
             if cap == cap:  # not NaN
                 capacity_rps = min(capacity_rps, cap)
         rate = max(args.rate_fraction * capacity_rps, 1.0)
-        # --- measured latency floor (VERDICT r3 #1, r4 #2) ------------
-        # The physics this transport permits for ONE record fired
-        # immediately: the dispatch call round trip + its own bytes over
-        # the sustained wire + the RESULT'S OWN d2h round trip + one
-        # poll interval of result collection.  The fetch term is r5's
-        # correction: the r4 floor priced the request leg only, but
-        # every result must cross the tunnel back — a second full
-        # request/response on this transport (the r5 fetch thread
-        # overlaps batch k's fetch with batch k+1's dispatch, which
-        # removes it from THROUGHPUT, but a record's own latency still
-        # serially contains its own fetch round trip; the decomposition
-        # measures it as the `fetch` stage).  Everything the framework
-        # adds on top of this is attributable overhead; a budget below
-        # it is infeasible BY MEASUREMENT, so the effective budget
-        # auto-raises above the floor.
-        idle_flush_s = args.open_loop_idle_flush_s
-        ol_wire_mb_s = wire_pre_ol["sustained_mb_s"] or wire["sustained_mb_s"]
-        one_record_wire_s = (
-            record_bytes / (ol_wire_mb_s * 1e6) if ol_wire_mb_s else 0.0
-        )
-        floor_s = rtt_s + one_record_wire_s + rtt_s + idle_flush_s
-        # Hard latency budget for the adaptive trigger (VERDICT r2 #2).
-        # This is a latency GOAL, independent of the batch fill time: a
-        # budget >= fill time makes the projection conclude "will fill"
-        # and park every window for the whole budget (measured: budget
-        # 1.0s vs fill 1.02s -> p50 1.31s).  With a 0.3s goal the EWMA
-        # policy flushes partial windows at the arrival cadence and p50
-        # lands near one inter-arrival gap + small-batch service time.
-        # The trigger additionally reserves the observed service time
-        # out of the budget (AdaptiveLatencyTrigger.observe_service_time).
-        requested_budget_s = (
-            args.open_loop_timeout_s if args.open_loop_timeout_s is not None
-            else 0.3
-        )
-        budget_s = max(requested_budget_s, 1.5 * floor_s)
 
         from flink_tensorflow_tpu.io import PacedSource
 
-        env2 = StreamExecutionEnvironment(parallelism=1)
-        samples = []  # (scheduled arrival, latency, stage stamps or None)
+        def run_open_loop(rate, wire_pre_ol, start_delay):
+            """One full paced pass at ``rate``; returns (open_loop dict,
+            post-pass wire probe).  Factored so a pass whose transport
+            collapsed mid-schedule (saturated=true — latency then
+            measures the tunnel backlog, not the service) can be
+            retried ONCE at a rate re-derived from the post-collapse
+            wire reading."""
+            # --- measured latency floor (VERDICT r3 #1, r4 #2) --------
+            # The physics this transport permits for ONE record fired
+            # immediately: the dispatch call round trip + its own bytes
+            # over the sustained wire + the RESULT'S OWN d2h round trip
+            # + one poll interval of result collection.  The fetch term
+            # is r5's correction: the r4 floor priced the request leg
+            # only, but every result must cross the tunnel back — a
+            # second full request/response on this transport (the r5
+            # fetch thread overlaps batch k's fetch with batch k+1's
+            # dispatch, which removes it from THROUGHPUT, but a record's
+            # own latency still serially contains its own fetch round
+            # trip; the decomposition measures it as the `fetch` stage).
+            # Everything the framework adds on top of this is
+            # attributable overhead; a budget below it is infeasible BY
+            # MEASUREMENT, so the effective budget auto-raises above it.
+            idle_flush_s = args.open_loop_idle_flush_s
+            ol_wire_mb_s = (wire_pre_ol["sustained_mb_s"]
+                            or wire["sustained_mb_s"])
+            one_record_wire_s = (
+                record_bytes / (ol_wire_mb_s * 1e6) if ol_wire_mb_s else 0.0
+            )
+            floor_s = rtt_s + one_record_wire_s + rtt_s + idle_flush_s
+            # Hard latency budget for the adaptive trigger (VERDICT r2
+            # #2).  This is a latency GOAL, independent of the batch
+            # fill time: a budget >= fill time makes the projection
+            # conclude "will fill" and park every window for the whole
+            # budget (measured: budget 1.0s vs fill 1.02s -> p50 1.31s).
+            # With a 0.3s goal the EWMA policy flushes partial windows
+            # at the arrival cadence and p50 lands near one
+            # inter-arrival gap + small-batch service time.  The trigger
+            # additionally reserves the observed service time out of the
+            # budget (AdaptiveLatencyTrigger.observe_service_time).
+            requested_budget_s = (
+                args.open_loop_timeout_s
+                if args.open_loop_timeout_s is not None else 0.3
+            )
+            budget_s = max(requested_budget_s, 1.5 * floor_s)
 
-        def ol_sink(record):
-            sched = record.meta.get("sched_ts")
-            if sched is not None:
-                st = record.meta.get("__stages__")
-                if st is not None and "__arrive_ts__" in record.meta:
-                    # Stamped by the window operator at ingestion; splits
-                    # upstream queueing from the trigger's own hold.
-                    st = {**st, "arrive_ts": record.meta["__arrive_ts__"]}
-                samples.append((sched, time.monotonic() - sched, st))
+            env2 = StreamExecutionEnvironment(parallelism=1)
+            samples = []  # (scheduled arrival, latency, stamps or None)
+
+            def ol_sink(record):
+                sched = record.meta.get("sched_ts")
+                if sched is not None:
+                    st = record.meta.get("__stages__")
+                    if st is not None and "__arrive_ts__" in record.meta:
+                        # Stamped by the window operator at ingestion;
+                        # splits upstream queueing from the trigger's
+                        # own hold.
+                        st = {**st, "arrive_ts": record.meta["__arrive_ts__"]}
+                    samples.append((sched, time.monotonic() - sched, st))
+
+            (
+                env2.from_source(
+                    PacedSource(ol_records, rate, jitter="poisson",
+                                start_delay_s=start_delay),
+                    name="paced", parallelism=1)
+                # Latency-targeting adaptive batching (SURVEY.md §7 hard
+                # part 3): fire early when the EWMA arrival-rate
+                # projection says the window won't fill inside budget.
+                .count_window(ol_batch, latency_budget_s=budget_s)
+                .apply(make_service(idle_flush_s=idle_flush_s,
+                                    stamp_stages=True),
+                       name="inception_ol")
+                .sink_to_callable(ol_sink)
+            )
+            env2.execute("bench-inception-open-loop", timeout=7200)
+            # Close the bracket around the open-loop pass: the mid probe
+            # ("wire") ran before calibration, this one right after the
+            # paced schedule — a saturated verdict below can be checked
+            # against what the transport actually sustained at pass end.
+            wire_after_ol = _wire_probe(dev, smoke=args.smoke, micro=True)
+            # Steady-state filter: the source's clock starts while the
+            # model operator may still be compiling in open(); records
+            # scheduled before the first result emerged carry that
+            # one-time warmup in their latency.  Measure only arrivals
+            # scheduled after it.
+            first_emit = min(s + l for s, l, _ in samples) if samples else 0.0
+            steady = [(s, l, st) for s, l, st in samples if s >= first_emit]
+            fallback = not steady
+            if fallback:
+                # Every record was scheduled before the first result
+                # emerged (pipeline warmup outlasted the whole
+                # schedule): the numbers below include warmup and must
+                # say so.
+                steady = list(samples)
+            p50, p99 = _percentiles_ms([l for _, l, _ in steady])
+            # --- per-sample latency decomposition (VERDICT r3 #1) -----
+            # Every stage boundary is stamped by the runner into the
+            # record's metadata; summed, the stages account for the
+            # whole end-to-end latency — no unexplained residue:
+            #   queue_wait     scheduled arrival -> record reached the
+            #                  window operator (channel/backpressure)
+            #   trigger_hold   operator arrival -> window fire/dispatch
+            #                  (pure trigger policy)
+            #   lane_wait      dispatch call -> a lane picks it up
+            #   h2d_dispatch   assemble + host->device wire + launch
+            #   ready_wait     launched -> the fetch thread reaches the
+            #                  batch (device compute + earlier batches'
+            #                  fetches overlap here)
+            #   fetch          this batch's own d2h round trip
+            #   emit           fetch done -> sink observed it
+            stage_vals = {k: [] for k in (
+                "queue_wait", "trigger_hold", "lane_wait", "h2d_dispatch",
+                "ready_wait", "fetch", "emit")}
+            for s, l, st in steady:
+                if not st:
+                    continue
+                arrive = st.get("arrive_ts", s)
+                stage_vals["queue_wait"].append(arrive - s)
+                stage_vals["trigger_hold"].append(st["t0"] - arrive)
+                # lane_wait includes coerce+assemble (they run on the
+                # lane thread before launch); h2d_dispatch is the launch
+                # interval proper — together the boundaries tile
+                # t0..t_done exactly.
+                stage_vals["lane_wait"].append(st["lane_wait_s"])
+                stage_vals["h2d_dispatch"].append(
+                    st["t_dispatched"] - st["t_lane_start"])
+                stage_vals["ready_wait"].append(
+                    st["t_fetch_start"] - st["t_dispatched"])
+                stage_vals["fetch"].append(st["t_done"] - st["t_fetch_start"])
+                stage_vals["emit"].append((s + l) - st["t_done"])
+            decomposition = {}
+            for k, vals in stage_vals.items():
+                if vals:
+                    sp50, sp99 = _percentiles_ms(vals)
+                    decomposition[k] = {"p50_ms": sp50, "p99_ms": sp99}
+            # Operating-point floor: the absolute floor prices a batch-1
+            # fire-at-once policy, but the trigger DELIBERATELY
+            # coalesces ~one inter-arrival gap of records per window
+            # (2-record windows halve the per-record RTT cost on this
+            # per-call-bound transport).  The floor of THAT policy at
+            # the offered rate: one gap of hold + the dispatch round
+            # trip + the median window's bytes + the result fetch round
+            # trip + one poll.  p50 above ~1.5x of this is queueing
+            # (transport service-time variance), not policy overhead.
+            batch_ns = sorted(
+                st["batch_n"] for _, _, st in steady if st and "batch_n" in st)
+            med_batch = batch_ns[len(batch_ns) // 2] if batch_ns else 1
+            gap_s = 1.0 / rate if rate else 0.0
+            operating_floor_s = (
+                gap_s + rtt_s + med_batch * one_record_wire_s + rtt_s
+                + idle_flush_s)
+            # Achieved service rate over the STEADY samples, anchored at
+            # their first scheduled arrival (not the first emission):
+            # when emissions burst — host starvation, backlog drains —
+            # an emission-to-emission span compresses and can report
+            # achieved > offered, silently defeating the saturation
+            # check.  Using the steady subset keeps one-time warmup out
+            # of the anchor (same filter as p50/p99), and the schedule
+            # anchor bounds achieved by the offered process.
+            if steady:
+                sched0 = min(s for s, l, _ in steady)
+                last_emit = max(s + l for s, l, _ in steady)
+                span = last_emit - sched0
+                achieved = len(steady) / span if span > 0 else float("nan")
+            else:
+                achieved = float("nan")
+            saturated = (
+                bool(achieved < 0.9 * rate) if achieved == achieved else True)
+            floor_ms = floor_s * 1e3
+            ol = {
+                "arrival_process": "poisson",
+                "offered_rate_rps": round(rate, 2),
+                "rate_fraction_of_capacity": args.rate_fraction,
+                "service_capacity_rps": round(service_rps, 2),
+                "capacity_cap_rps": round(capacity_rps, 2),
+                "service_batch": ol_batch,
+                "trigger": "adaptive_latency_ewma+service_reserve",
+                "result_collection": (
+                    f"background fetch thread + completion wake; "
+                    f"{idle_flush_s*1e3:.0f}ms poll backstop"),
+                "latency_budget_requested_ms": round(
+                    requested_budget_s * 1e3, 1),
+                # Effective budget: auto-raised to 1.5x the measured
+                # floor when the requested budget is infeasible on this
+                # transport.
+                "latency_budget_ms": round(budget_s * 1e3, 1),
+                "budget_auto_raised": bool(budget_s > requested_budget_s),
+                # The measured floor: dispatch RTT + one record's bytes
+                # over the sustained wire + the result's own fetch RTT +
+                # one collection-poll interval.  No configuration of
+                # this framework (or any other) beats it here.
+                "latency_floor_ms": round(floor_ms, 1),
+                "floor_components_ms": {
+                    "fixed_call_roundtrip": round(rtt_s * 1e3, 1),
+                    "one_record_wire": round(one_record_wire_s * 1e3, 1),
+                    # The result's own d2h round trip (r5): measured by
+                    # the same noop-fetch probe as the dispatch leg; the
+                    # decomposition's `fetch` stage shows what it
+                    # actually cost (queueing behind concurrent h2d
+                    # inflates it).
+                    "result_fetch_roundtrip": round(rtt_s * 1e3, 1),
+                    "collection_poll": round(idle_flush_s * 1e3, 1),
+                },
+                "records": ol_n,
+                "steady_state_samples": len(steady),
+                "warmup_contaminated": fallback,
+                "achieved_rate_rps": round(achieved, 2),
+                # True when the transport could not sustain the offered
+                # rate (latency then measures the tunnel's backlog, not
+                # the framework's service time).
+                "saturated": saturated,
+                # The wire bracket for THIS pass: "before" ran right
+                # before the schedule (it set the floor), "after" right
+                # after it.  An offered_mb_s above the after-reading
+                # explains a saturated=true verdict as mid-pass
+                # transport drift.
+                "wire_sustained_mb_s_bracket": [
+                    wire_pre_ol["sustained_mb_s"],
+                    wire_after_ol["sustained_mb_s"]],
+                "offered_mb_s": round(rate * record_bytes / 1e6, 2),
+                "p50_latency_ms": p50,
+                "p99_latency_ms": p99,
+                "p50_over_floor": (
+                    round(p50 / floor_ms, 2) if floor_ms else None),
+                "median_fired_window": med_batch,
+                "latency_floor_at_operating_point_ms": round(
+                    operating_floor_s * 1e3, 1),
+                "p50_over_operating_floor": (
+                    round(p50 / (operating_floor_s * 1e3), 2)
+                    if operating_floor_s else None),
+                "budget_met": bool(p50 == p50 and p50 <= budget_s * 1e3),
+                "per_sample_decomposition_ms": decomposition,
+            }
+            return ol, wire_after_ol
 
         # Delay the schedule past the pipeline's open(); the service
         # bucket's executable is already in the persistent cache from
         # calibration, so this covers trace+load, not a full compile.
         start_delay = 0.0 if args.smoke else args.open_loop_start_delay_s
-        (
-            env2.from_source(PacedSource(ol_records, rate, jitter="poisson",
-                                         start_delay_s=start_delay),
-                             name="paced", parallelism=1)
-            # Latency-targeting adaptive batching (SURVEY.md §7 hard
-            # part 3): fire early when the EWMA arrival-rate projection
-            # says the window won't fill inside the budget.
-            .count_window(ol_batch, latency_budget_s=budget_s)
-            .apply(make_service(idle_flush_s=idle_flush_s,
-                                stamp_stages=True),
-                   name="inception_ol")
-            .sink_to_callable(ol_sink)
-        )
-        env2.execute("bench-inception-open-loop", timeout=7200)
-        # Close the bracket around the open-loop pass: the mid probe
-        # ("wire") ran before calibration, this one right after the
-        # paced schedule — a saturated verdict below can be checked
-        # against what the transport actually sustained at pass end.
-        wire_after_ol = _wire_probe(dev, smoke=args.smoke, micro=True)
-        # Steady-state filter: the source's clock starts while the model
-        # operator may still be compiling in open(); records scheduled
-        # before the first result emerged carry that one-time warmup in
-        # their latency.  Measure only arrivals scheduled after it.
-        first_emit = min(s + l for s, l, _ in samples) if samples else 0.0
-        steady = [(s, l, st) for s, l, st in samples if s >= first_emit]
-        fallback = not steady
-        if fallback:
-            # Every record was scheduled before the first result emerged
-            # (pipeline warmup outlasted the whole schedule): the numbers
-            # below include warmup and must say so.
-            steady = list(samples)
-        p50, p99 = _percentiles_ms([l for _, l, _ in steady])
-        # --- per-sample latency decomposition (VERDICT r3 #1) ---------
-        # Every stage boundary is stamped by the runner into the record's
-        # metadata; summed, the stages account for the whole end-to-end
-        # latency — no unexplained residue:
-        #   queue_wait     scheduled arrival -> record reached the window
-        #                  operator (upstream channel/backpressure)
-        #   trigger_hold   operator arrival -> window fire/dispatch
-        #                  (pure trigger policy)
-        #   lane_wait      dispatch call -> a transfer lane picks it up
-        #   h2d_dispatch   assemble + host->device wire + launch
-        #   ready_wait     launched -> the poll loop starts the fetch
-        #                  (device compute overlaps here; ~2ms/16-batch
-        #                  per the compute probe, so this is wire+poll)
-        #   fetch          device->host result transfer (tunnel RTT-bound)
-        #   emit           fetch done -> sink observed it
-        stage_vals = {k: [] for k in (
-            "queue_wait", "trigger_hold", "lane_wait", "h2d_dispatch",
-            "ready_wait", "fetch", "emit")}
-        for s, l, st in steady:
-            if not st:
-                continue
-            arrive = st.get("arrive_ts", s)
-            stage_vals["queue_wait"].append(arrive - s)
-            stage_vals["trigger_hold"].append(st["t0"] - arrive)
-            # lane_wait includes coerce+assemble (they run on the lane
-            # thread before launch); h2d_dispatch is the launch interval
-            # proper — together the boundaries tile t0..t_done exactly.
-            stage_vals["lane_wait"].append(st["lane_wait_s"])
-            stage_vals["h2d_dispatch"].append(
-                st["t_dispatched"] - st["t_lane_start"])
-            stage_vals["ready_wait"].append(
-                st["t_fetch_start"] - st["t_dispatched"])
-            stage_vals["fetch"].append(st["t_done"] - st["t_fetch_start"])
-            stage_vals["emit"].append((s + l) - st["t_done"])
-        decomposition = {}
-        for k, vals in stage_vals.items():
-            if vals:
-                sp50, sp99 = _percentiles_ms(vals)
-                decomposition[k] = {"p50_ms": sp50, "p99_ms": sp99}
-        # Operating-point floor: the absolute floor prices a batch-1
-        # fire-at-once policy, but the trigger DELIBERATELY coalesces
-        # ~one inter-arrival gap of records per window (2-record windows
-        # halve the per-record RTT cost on this per-call-bound
-        # transport).  The floor of THAT policy at the offered rate:
-        # one gap of hold + the dispatch round trip + the median
-        # window's bytes + the result fetch round trip + one poll.
-        # p50 above ~1.5x of this is queueing (transport service-time
-        # variance), not policy overhead.
-        batch_ns = sorted(
-            st["batch_n"] for _, _, st in steady if st and "batch_n" in st)
-        med_batch = batch_ns[len(batch_ns) // 2] if batch_ns else 1
-        gap_s = 1.0 / rate if rate else 0.0
-        operating_floor_s = (
-            gap_s + rtt_s + med_batch * one_record_wire_s + rtt_s
-            + idle_flush_s)
-        # Achieved service rate over the STEADY samples, anchored at
-        # their first scheduled arrival (not the first emission): when
-        # emissions burst — host starvation, backlog drains — an
-        # emission-to-emission span compresses and can report
-        # achieved > offered, silently defeating the saturation check.
-        # Using the steady subset keeps one-time warmup out of the
-        # anchor (same filter as p50/p99), and the schedule anchor
-        # bounds achieved by the offered process.
-        if steady:
-            sched0 = min(s for s, l, _ in steady)
-            last_emit = max(s + l for s, l, _ in steady)
-            span = last_emit - sched0
-            achieved = len(steady) / span if span > 0 else float("nan")
-        else:
-            achieved = float("nan")
-        saturated = bool(achieved < 0.9 * rate) if achieved == achieved else True
-        floor_ms = floor_s * 1e3
-        out["open_loop"] = {
-            "arrival_process": "poisson",
-            "offered_rate_rps": round(rate, 2),
-            "rate_fraction_of_capacity": args.rate_fraction,
-            "service_capacity_rps": round(service_rps, 2),
-            "capacity_cap_rps": round(capacity_rps, 2),
-            "service_batch": ol_batch,
-            "trigger": "adaptive_latency_ewma+service_reserve",
-            "result_collection": f"ready-poll every {idle_flush_s*1e3:.0f}ms",
-            "latency_budget_requested_ms": round(requested_budget_s * 1e3, 1),
-            # Effective budget: auto-raised to 1.5x the measured floor
-            # when the requested budget is infeasible on this transport.
-            "latency_budget_ms": round(budget_s * 1e3, 1),
-            "budget_auto_raised": bool(budget_s > requested_budget_s),
-            # The measured floor: dispatch RTT + one record's bytes over
-            # the sustained wire + the result's own fetch RTT + one
-            # collection-poll interval.  No configuration of this
-            # framework (or any other) beats it on this transport.
-            "latency_floor_ms": round(floor_ms, 1),
-            "floor_components_ms": {
-                "fixed_call_roundtrip": round(rtt_s * 1e3, 1),
-                "one_record_wire": round(one_record_wire_s * 1e3, 1),
-                # The result's own d2h round trip (r5): measured by the
-                # same noop-fetch probe as the dispatch leg; the
-                # decomposition's `fetch` stage shows what it actually
-                # cost (queueing behind concurrent h2d inflates it).
-                "result_fetch_roundtrip": round(rtt_s * 1e3, 1),
-                "collection_poll": round(idle_flush_s * 1e3, 1),
-            },
-            "records": ol_n,
-            "steady_state_samples": len(steady),
-            "warmup_contaminated": fallback,
-            "achieved_rate_rps": round(achieved, 2),
-            # True when the transport could not sustain the offered rate
-            # (latency then measures the tunnel's backlog, not the
-            # framework's service time).
-            "saturated": saturated,
-            # The wire bracket for THIS pass: "before" ran right after
-            # calibration (it set the capacity cap and the floor),
-            # "after" right after the paced schedule.  An offered_mb_s
-            # above the after-reading explains a saturated=true verdict
-            # as mid-pass transport drift.
-            "wire_sustained_mb_s_bracket": [
-                wire_pre_ol["sustained_mb_s"],
-                wire_after_ol["sustained_mb_s"]],
-            "offered_mb_s": round(rate * record_bytes / 1e6, 2),
-            "p50_latency_ms": p50,
-            "p99_latency_ms": p99,
-            "p50_over_floor": (
-                round(p50 / floor_ms, 2) if floor_ms else None),
-            "median_fired_window": med_batch,
-            "latency_floor_at_operating_point_ms": round(
-                operating_floor_s * 1e3, 1),
-            "p50_over_operating_floor": (
-                round(p50 / (operating_floor_s * 1e3), 2)
-                if operating_floor_s else None),
-            "budget_met": bool(p50 == p50 and p50 <= budget_s * 1e3),
-            "per_sample_decomposition_ms": decomposition,
-        }
+        ol, wire_after_ol = run_open_loop(rate, wire_pre_ol, start_delay)
+        # Retry once when the transport fell below the offered rate
+        # mid-pass (token bucket drained, phase collapse): the measured
+        # latency is then a backlog, not the service.  Guards: a pass
+        # with NO samples saturated for some other reason (a fault, not
+        # a rate overload — rerunning at a derived rate is meaningless),
+        # and with no finite cap basis there is nothing to re-derive
+        # from.  The retry rate is capped at the original (a re-derived
+        # rate can never be HIGHER; when the post-collapse wire reads
+        # recovered, a same-rate retry covers the transient-collapse
+        # case on the fresh phase).  The saturated first attempt stays
+        # in the output for the record — its verdict is evidence of the
+        # transport's behavior, not the framework's.
+        if ol["saturated"] and not args.smoke and ol["steady_state_samples"]:
+            after_ceiling = (
+                wire_after_ol["sustained_mb_s"] * 1e6 / record_bytes
+                if record_bytes and wire_after_ol["sustained_mb_s"]
+                else float("nan")
+            )
+            retry_caps = [c for c in (capacity_rps, after_ceiling)
+                          if c == c and c > 0]
+            if retry_caps:
+                retry_cap = min(retry_caps)
+                retry_rate = min(
+                    rate, max(args.rate_fraction * retry_cap, 1.0))
+                first = {k: ol.get(k) for k in (
+                    "offered_rate_rps", "achieved_rate_rps",
+                    "p50_latency_ms", "p99_latency_ms", "saturated",
+                    "wire_sustained_mb_s_bracket")}
+                # Same warmup delay as the first pass: the retry builds
+                # a fresh operator whose open() re-runs trace+load.
+                ol, wire_after_ol = run_open_loop(
+                    retry_rate, wire_after_ol, start_delay)
+                ol["retry_of_saturated_pass"] = True
+                # The cap that actually produced the retry's offered
+                # rate (the closure reports the first-pass cap).
+                ol["capacity_cap_rps"] = round(retry_cap, 2)
+                ol["first_attempt_saturated"] = first
+        out["open_loop"] = ol
     return out
 
 
