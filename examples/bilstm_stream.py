@@ -51,7 +51,10 @@ def main(argv=None):
 
     env = StreamExecutionEnvironment(parallelism=args.parallelism)
     results = (
-        env.from_collection(records, parallelism=1)
+        # Plan-time schema: tokens has a dynamic (None) length dim — the
+        # analyzer confirms the model's length-bucketing policy resolves
+        # it before anything reaches XLA.
+        env.from_collection(records, parallelism=1, schema=mdef.input_schema)
         .rebalance()
         .count_window(args.batch, timeout_s=0.05)
         .apply(ModelWindowFunction(model), name="bilstm",

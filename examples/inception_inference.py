@@ -71,7 +71,9 @@ def main(argv=None):
         env.enable_checkpointing(args.output_dir + ".chk",
                                  every_n_records=4 * args.batch)
     labeled = (
-        env.from_collection(records, parallelism=1)
+        # Source schema declaration — plan-time validation against the
+        # model contract (see flink_tensorflow_tpu.analysis).
+        env.from_collection(records, parallelism=1, schema=mdef.input_schema)
         .rebalance()
         .count_window(args.batch, timeout_s=0.05)
         .apply(

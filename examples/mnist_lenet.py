@@ -32,7 +32,10 @@ def main(argv=None):
 
     env = StreamExecutionEnvironment(parallelism=args.parallelism)
     results = (
-        env.from_collection(records, parallelism=1)
+        # Declaring the source schema lets the plan analyzer check the
+        # stream against the model's input contract before execution
+        # (python -m flink_tensorflow_tpu.analysis examples/mnist_lenet.py).
+        env.from_collection(records, parallelism=1, schema=mdef.input_schema)
         .rebalance()
         # count-or-timeout: bounds p50 latency when the stream runs dry
         # (SURVEY.md §7 hard part 3 — adaptive batching).

@@ -63,7 +63,9 @@ def main(argv=None):
     env = StreamExecutionEnvironment(parallelism=1)
     env.set_mesh(mesh)
     out = (
-        env.from_collection(records, parallelism=1)
+        # Schema declaration: the analyzer checks it against train_schema
+        # and the mesh-divisibility of the gang step at plan time.
+        env.from_collection(records, parallelism=1, schema=schema)
         .count_window(args.batch)
         .apply(DPTrainWindowFunction(mdef, optax.adam(1e-3), train_schema=schema,
                                      global_batch=args.batch),

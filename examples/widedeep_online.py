@@ -66,7 +66,9 @@ def main(argv=None):
 
     env = StreamExecutionEnvironment(parallelism=args.parallelism)
     out = (
-        env.from_collection(records, parallelism=1)
+        # The train schema doubles as the source's record schema, so the
+        # plan analyzer validates the keyed pipeline end to end.
+        env.from_collection(records, parallelism=1, schema=schema)
         .key_by(lambda r: r.meta["user"])
         .process(
             OnlineTrainFunction(mdef, optax.adam(1e-2), train_schema=schema,

@@ -1,0 +1,53 @@
+"""Plan-time static analysis over the DataflowGraph.
+
+The TypeInformation role the reference got from Flink's job-graph
+translation, rebuilt for the TPU-native plan: schema/shape/dtype
+propagation through every operator, a lint-rule registry (cycles,
+dangling roots, keyed partitioning, mesh divisibility, dynamic dims at
+jit boundaries, recompilation churn), and three front doors —
+
+- ``analyze(graph, config=...) -> list[Diagnostic]``
+- ``env.execute(..., validate=True)`` (raises PlanValidationError on ERROR)
+- ``python -m flink_tensorflow_tpu.analysis examples/<pipeline>.py``
+
+All of it runs before a single record is emitted or a chip is touched.
+"""
+
+from flink_tensorflow_tpu.analysis.analyzer import analyze, has_errors
+from flink_tensorflow_tpu.analysis.capture import (
+    PlanCaptured,
+    capture_pipeline_file,
+    capture_plan,
+    capturing_execution,
+)
+from flink_tensorflow_tpu.analysis.diagnostics import (
+    Diagnostic,
+    PlanValidationError,
+    Severity,
+    edge_name,
+    format_diagnostics,
+    worst_severity,
+)
+from flink_tensorflow_tpu.analysis.rules import RULES, AnalysisContext, LintRule, rule
+from flink_tensorflow_tpu.analysis.schema_prop import SchemaFlow, propagate
+
+__all__ = [
+    "RULES",
+    "AnalysisContext",
+    "Diagnostic",
+    "LintRule",
+    "PlanCaptured",
+    "PlanValidationError",
+    "SchemaFlow",
+    "Severity",
+    "analyze",
+    "capture_pipeline_file",
+    "capture_plan",
+    "capturing_execution",
+    "edge_name",
+    "format_diagnostics",
+    "has_errors",
+    "propagate",
+    "rule",
+    "worst_severity",
+]

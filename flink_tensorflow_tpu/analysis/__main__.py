@@ -1,0 +1,69 @@
+"""CLI: analyze pipeline scripts without executing them.
+
+    python -m flink_tensorflow_tpu.analysis examples/mnist_lenet.py [more.py ...]
+
+Builds each script's DataflowGraph (its ``main(argv)`` runs under
+execute-capture, so the stream job never starts), runs the plan
+analyzer, and prints diagnostics with edge-level provenance.  Exit code
+0 = no ERROR diagnostics anywhere, 1 = at least one ERROR, 2 = a script
+could not be captured at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from flink_tensorflow_tpu.analysis.analyzer import analyze, has_errors
+from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
+from flink_tensorflow_tpu.analysis.diagnostics import format_diagnostics
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flink_tensorflow_tpu.analysis",
+        description="Plan-time analyzer: schema propagation + graph lints "
+                    "over a pipeline script's DataflowGraph, without "
+                    "executing the job.",
+    )
+    parser.add_argument("pipelines", nargs="+", metavar="pipeline.py",
+                        help="pipeline script(s) defining main(argv)")
+    parser.add_argument("--job-args", default="--smoke --cpu",
+                        help="argv passed to each pipeline's main() while "
+                             "building its graph (default: '--smoke --cpu')")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON object per pipeline")
+    args = parser.parse_args(argv)
+
+    job_args = args.job_args.split()
+    exit_code = 0
+    for path in args.pipelines:
+        try:
+            env = capture_pipeline_file(path, job_args)
+        except Exception as ex:  # noqa: BLE001 - report and keep going
+            print(f"{path}: capture failed: {ex}", file=sys.stderr)
+            exit_code = max(exit_code, 2)
+            continue
+        diags = analyze(env.graph, config=env.config)
+        if args.json:
+            print(json.dumps({
+                "pipeline": path,
+                "operators": len(env.graph.transformations),
+                "diagnostics": [
+                    {"rule": d.rule, "severity": d.severity.name,
+                     "message": d.message, "node": d.node, "edge": d.edge}
+                    for d in diags
+                ],
+            }))
+        else:
+            n = len(env.graph.transformations)
+            print(f"== {path} ({n} operators) ==")
+            print(format_diagnostics(diags))
+        if has_errors(diags):
+            exit_code = max(exit_code, 1)
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

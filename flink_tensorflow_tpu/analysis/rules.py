@@ -1,0 +1,299 @@
+"""Lint rule registry — structural checks over the logical plan.
+
+Each rule is a function ``(ctx, emit) -> None`` registered under a
+stable id with a fixed severity; ``emit(message, node=..., edge=...)``
+records one diagnostic.  Rules see the :class:`AnalysisContext`: the
+graph, its topological order, one *uninitialized* operator instance per
+transformation (factories are cheap — ``open()`` is never called, so no
+device or model state is touched), the propagated schemas, and the
+job config when the caller provided one.
+
+Deferred (ROADMAP "Open items"): sharding-axis lints (NamedSharding
+annotations vs mesh axes) and watermark lints (event-time windows with
+no timestamp assigner upstream).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+from flink_tensorflow_tpu.analysis.diagnostics import Diagnostic, Severity, edge_name
+from flink_tensorflow_tpu.core.graph import DataflowGraph, Edge, Transformation
+from flink_tensorflow_tpu.core.operators import Operator
+from flink_tensorflow_tpu.core.partitioning import ForwardPartitioner, HashPartitioner
+from flink_tensorflow_tpu.tensors.schema import RecordSchema
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    graph: DataflowGraph
+    order: typing.List[Transformation]
+    #: transformation id -> operator instance (or None if the factory
+    #: could not run at plan time).
+    operators: typing.Dict[int, typing.Optional[Operator]]
+    #: transformation id -> sole propagated output schema (None = unknown
+    #: or ambiguous).
+    schemas: typing.Dict[int, typing.Optional[RecordSchema]]
+    #: transformation id -> all distinct schemas flowing out of the node.
+    schema_sets: typing.Dict[int, typing.List[RecordSchema]]
+    #: JobConfig when analyzing through an environment; None for a bare
+    #: graph (config-dependent rules skip themselves).
+    config: typing.Optional[typing.Any] = None
+
+    def function_of(self, t: Transformation):
+        """The user function hosted by ``t``'s operator, if any."""
+        return getattr(self.operators.get(t.id), "function", None)
+
+    def input_schema(self, t: Transformation) -> typing.Optional[RecordSchema]:
+        """Sole known schema arriving at ``t`` (None = unknown/ambiguous)."""
+        arriving = self.input_schema_set(t)
+        return arriving[0] if len(arriving) == 1 else None
+
+    def input_schema_set(self, t: Transformation) -> typing.List[RecordSchema]:
+        arriving: typing.List[RecordSchema] = []
+        seen: typing.Set[RecordSchema] = set()
+        for e in t.inputs:
+            for s in self.schema_sets.get(e.upstream.id, []):
+                if s not in seen:
+                    seen.add(s)
+                    arriving.append(s)
+        return arriving
+
+    def is_keyed(self, t: Transformation) -> bool:
+        op = self.operators.get(t.id)
+        return any(
+            getattr(op, attr, None) is not None
+            for attr in ("key_selector", "key_selector1")
+        )
+
+
+Emit = typing.Callable[..., None]
+RuleFn = typing.Callable[[AnalysisContext, Emit], None]
+
+
+@dataclasses.dataclass(frozen=True)
+class LintRule:
+    id: str
+    severity: Severity
+    doc: str
+    fn: RuleFn
+
+
+#: Registry, in registration (= report) order.
+RULES: typing.Dict[str, LintRule] = {}
+
+
+def rule(rule_id: str, severity: Severity):
+    def register(fn: RuleFn) -> RuleFn:
+        if rule_id in RULES:
+            raise ValueError(f"duplicate lint rule id {rule_id!r}")
+        RULES[rule_id] = LintRule(rule_id, severity, fn.__doc__ or "", fn)
+        return fn
+
+    return register
+
+
+def run_rules(ctx: AnalysisContext) -> typing.List[Diagnostic]:
+    diags: typing.List[Diagnostic] = []
+    for lint in RULES.values():
+        def emit(message: str, node: typing.Optional[str] = None,
+                 edge: typing.Optional[str] = None,
+                 severity: typing.Optional[Severity] = None) -> None:
+            # NOT `severity or ...`: Severity.INFO is 0 and falsy.
+            diags.append(Diagnostic(
+                rule=lint.id,
+                severity=lint.severity if severity is None else severity,
+                message=message, node=node, edge=edge,
+            ))
+        lint.fn(ctx, emit)
+    return diags
+
+
+def _edge_str(e: Edge, t: Transformation) -> str:
+    return edge_name(e.upstream.name, t.name)
+
+
+def _plan_policy(function) -> typing.Optional[typing.Any]:
+    """The function's plan-time BucketPolicy, via the ``plan_policy``
+    hook or the conventional ``_policy`` attribute."""
+    hook = getattr(function, "plan_policy", None)
+    if hook is not None:
+        return hook()
+    return getattr(function, "_policy", None)
+
+
+# ---------------------------------------------------------------------------
+# Rules.  (Cycle detection lives in analyzer.analyze(): a cyclic graph has
+# no topological order, so no other rule can run — it is reported alone.)
+# ---------------------------------------------------------------------------
+
+
+@rule("dangling-root", Severity.ERROR)
+def _dangling_roots(ctx: AnalysisContext, emit: Emit) -> None:
+    """A non-source operator with no inputs never receives a record (and
+    never an end-of-partition): dead plan wiring."""
+    for t in ctx.order:
+        if not t.is_source and not t.inputs:
+            emit(
+                "operator has no inputs and is not a source — it will "
+                "never receive records; wire an upstream edge or add it "
+                "via from_source(...)",
+                node=t.name,
+            )
+
+
+@rule("keyed-partitioning", Severity.ERROR)
+def _keyed_partitioning(ctx: AnalysisContext, emit: Emit) -> None:
+    """Keyed-state operators must be fed by hash edges: any other
+    partitioner can route two records of the same key to different
+    subtasks, silently splitting their keyed state."""
+    for t in ctx.order:
+        if not ctx.is_keyed(t):
+            continue
+        for e in t.inputs:
+            if not isinstance(e.partitioner, HashPartitioner):
+                emit(
+                    f"keyed operator is fed by "
+                    f"{type(e.partitioner).__name__} — records of one key "
+                    "may land on different subtasks and split their keyed "
+                    "state; partition this edge by key (key_by)",
+                    node=t.name, edge=_edge_str(e, t),
+                )
+
+
+@rule("forward-parallelism", Severity.ERROR)
+def _forward_parallelism(ctx: AnalysisContext, emit: Emit) -> None:
+    """Forward (1:1) edges require equal upstream/downstream parallelism
+    — the runtime rejects this at build; catch it at plan time."""
+    for t in ctx.order:
+        for e in t.inputs:
+            if (isinstance(e.partitioner, ForwardPartitioner)
+                    and e.upstream.parallelism != t.parallelism):
+                emit(
+                    f"forward edge requires equal parallelism "
+                    f"({e.upstream.parallelism} vs {t.parallelism}); "
+                    "rebalance() the hop or align the parallelisms",
+                    node=t.name, edge=_edge_str(e, t),
+                )
+
+
+@rule("keyed-parallelism-bound", Severity.ERROR)
+def _keyed_parallelism_bound(ctx: AnalysisContext, emit: Emit) -> None:
+    """Keyed parallelism above max_parallelism leaves subtasks with no
+    key group — they would idle forever (the runtime refuses too)."""
+    if ctx.config is None:
+        return
+    bound = ctx.config.max_parallelism
+    for t in ctx.order:
+        if ctx.is_keyed(t) and t.parallelism > bound:
+            emit(
+                f"keyed operator parallelism {t.parallelism} exceeds "
+                f"max_parallelism {bound} — key groups cannot cover all "
+                "subtasks; raise JobConfig.max_parallelism",
+                node=t.name,
+            )
+
+
+@rule("mesh-divisibility", Severity.ERROR)
+def _mesh_divisibility(ctx: AnalysisContext, emit: Emit) -> None:
+    """Device-bound gang stages (DP training) must fit the mesh: stream
+    parallelism 1, a mesh configured, and the global batch dividing the
+    mesh's data axis — otherwise open() fails (or worse, the first
+    collective hangs) after the job already started."""
+    for t in ctx.order:
+        function = ctx.function_of(t)
+        if not getattr(function, "is_gang", False):
+            continue
+        if t.parallelism != 1:
+            emit(
+                f"gang operator runs at stream parallelism "
+                f"{t.parallelism}; a gang owns the whole mesh and must "
+                "run at parallelism 1 (devices parallelize inside the "
+                "pjit-ed step, not across subtasks)",
+                node=t.name,
+            )
+        if ctx.config is None:
+            continue
+        mesh = ctx.config.mesh
+        if mesh is None:
+            emit(
+                "gang operator needs env.set_mesh(...) — it owns the "
+                "device mesh and cannot open without one",
+                node=t.name,
+            )
+            continue
+        data_axis = dict(mesh.shape).get("data", 1)
+        global_batch = getattr(function, "global_batch", None)
+        if global_batch is not None and data_axis and global_batch % data_axis:
+            emit(
+                f"global_batch {global_batch} does not divide the mesh "
+                f"data axis ({data_axis}) — per-device shards would be "
+                "ragged; pick a multiple",
+                node=t.name,
+            )
+
+
+@rule("dynamic-jit-boundary", Severity.ERROR)
+def _dynamic_jit_boundary(ctx: AnalysisContext, emit: Emit) -> None:
+    """Dynamic (None) dims reaching a jit boundary without a bucketing
+    policy: every observed length would compile a fresh executable —
+    the recompilation churn PAPER.md §0's static-shape invariant exists
+    to prevent.  A length BucketLadder resolves it (INFO when present)."""
+    for t in ctx.order:
+        function = ctx.function_of(t)
+        if not getattr(function, "is_jit_boundary", False):
+            continue
+        in_schema = ctx.input_schema(t)
+        if in_schema is None or in_schema.is_static:
+            continue
+        dyn = [n for n in in_schema.names if not in_schema[n].is_static]
+        policy = _plan_policy(function)
+        ladder = getattr(policy, "lengths", None) if policy is not None else None
+        if ladder is None or not getattr(ladder, "sizes", None):
+            emit(
+                f"dynamic dims on field(s) {dyn} reach this jit boundary "
+                "with no length-bucketing policy — every distinct length "
+                "compiles a new executable; give the operator a "
+                "BucketPolicy with a lengths ladder (or bucket upstream)",
+                node=t.name,
+            )
+        else:
+            emit(
+                f"dynamic dims on field(s) {dyn} are resolved by the "
+                f"length ladder {list(ladder.sizes)[:8]}",
+                node=t.name, severity=Severity.INFO,
+            )
+
+
+@rule("recompile-churn", Severity.WARN)
+def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
+    """Shape-signature churn at jit boundaries: several distinct schemas
+    on one input (e.g. a union of differently-shaped streams) thrash the
+    compile cache batch by batch; window fires reaching a jit function
+    with no batch bucketing compile once per distinct fire size."""
+    from flink_tensorflow_tpu.core.operators import WindowOperator
+
+    for t in ctx.order:
+        function = ctx.function_of(t)
+        if not getattr(function, "is_jit_boundary", False):
+            continue
+        arriving = ctx.input_schema_set(t)
+        if len(arriving) > 1:
+            emit(
+                f"{len(arriving)} distinct schema signatures flow into "
+                "this jit boundary — each alternation recompiles or "
+                "round-robins executables; split the streams or coerce "
+                "to one schema upstream: "
+                + "; ".join(repr(s) for s in arriving),
+                node=t.name,
+            )
+        policy = _plan_policy(function)
+        if (isinstance(ctx.operators.get(t.id), WindowOperator)
+                and policy is None):
+            emit(
+                "window fires reach a jit boundary with no batch-bucket "
+                "policy — partial fires (timeouts, end of input) each "
+                "compile a fresh batch size; set a BucketPolicy",
+                node=t.name,
+            )

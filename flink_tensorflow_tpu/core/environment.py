@@ -15,7 +15,7 @@ import typing
 import warnings
 
 from flink_tensorflow_tpu.core import functions as fn
-from flink_tensorflow_tpu.core.config import CheckpointConfig, JobConfig
+from flink_tensorflow_tpu.core.config import JobConfig
 from flink_tensorflow_tpu.core.graph import DataflowGraph
 from flink_tensorflow_tpu.core.operators import SourceOperator
 from flink_tensorflow_tpu.core.runtime import LocalExecutor
@@ -208,18 +208,25 @@ class StreamExecutionEnvironment:
 
     # -- sources ----------------------------------------------------------
     def from_collection(
-        self, data: typing.Sequence[typing.Any], *, name="collection", parallelism: int = 1
+        self, data: typing.Sequence[typing.Any], *, name="collection",
+        parallelism: int = 1, schema=None,
     ) -> DataStream:
-        return self.from_source(CollectionSource(data), name=name, parallelism=parallelism)
+        return self.from_source(CollectionSource(data), name=name,
+                                parallelism=parallelism, schema=schema)
 
     def from_source(
-        self, source: fn.SourceFunction, *, name="source", parallelism: int = 1
+        self, source: fn.SourceFunction, *, name="source", parallelism: int = 1,
+        schema=None,
     ) -> DataStream:
+        """``schema`` (a RecordSchema) declares the records this source
+        emits — plan-time only: the analyzer propagates it downstream and
+        validates operator contracts against it before execution."""
         t = self.graph.add(
             name,
             lambda: SourceOperator(name, source),
             parallelism,
             is_source=True,
+            declared_schema=schema,
         )
         return DataStream(self, t)
 
@@ -228,6 +235,27 @@ class StreamExecutionEnvironment:
         keyed/rebalance edges span processes through the record plane
         (core.distributed.DistributedConfig)."""
         return self.configure(distributed=distributed)
+
+    # -- plan validation ---------------------------------------------------
+    def validate_plan(self, *, raise_on_error: bool = True):
+        """Run the plan-time analyzer over this environment's graph.
+
+        Returns the diagnostics (most severe first).  With
+        ``raise_on_error`` (the default), ERROR diagnostics raise
+        :class:`~flink_tensorflow_tpu.analysis.PlanValidationError`
+        before any executor is built — the ``execute(validate=True)``
+        gate.
+        """
+        from flink_tensorflow_tpu.analysis import (
+            PlanValidationError,
+            analyze,
+            has_errors,
+        )
+
+        diagnostics = analyze(self.graph, config=self.config)
+        if raise_on_error and has_errors(diagnostics):
+            raise PlanValidationError(diagnostics)
+        return diagnostics
 
     # -- execution ---------------------------------------------------------
     def _resolve_checkpoint_location(self, d: typing.Optional[str]) -> typing.Optional[str]:
@@ -268,8 +296,13 @@ class StreamExecutionEnvironment:
         restore_from: typing.Optional[str] = None,
         restore_checkpoint_id: typing.Optional[int] = None,
         restart_strategy: typing.Optional[RestartStrategy] = None,
+        validate: bool = False,
     ) -> JobResult:
         """Run the job to completion on the local executor.
+
+        ``validate=True`` runs the plan-time analyzer first and raises
+        ``PlanValidationError`` on ERROR diagnostics — bad plans fail
+        before touching a device (see flink_tensorflow_tpu.analysis).
 
         With a ``restart_strategy`` (requires ``enable_checkpointing``),
         failures restart the job from the latest persisted snapshot — the
@@ -277,6 +310,8 @@ class StreamExecutionEnvironment:
         """
         from flink_tensorflow_tpu.core.runtime import JobFailure, JobTimeout
 
+        if validate:
+            self.validate_plan()
         if restart_strategy is None:
             handle = self.execute_async(
                 job_name, restore_from=restore_from,
@@ -337,7 +372,10 @@ class StreamExecutionEnvironment:
         *,
         restore_from: typing.Optional[str] = None,
         restore_checkpoint_id: typing.Optional[int] = None,
+        validate: bool = False,
     ) -> JobHandle:
+        if validate:
+            self.validate_plan()
         executor = self._make_executor()
         executor.checkpoint_interval_s = self.checkpoint_interval_s
         if restore_from is not None:

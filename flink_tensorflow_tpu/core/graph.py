@@ -15,6 +15,22 @@ from flink_tensorflow_tpu.core.partitioning import Partitioner
 
 if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.core.operators import Operator
+    from flink_tensorflow_tpu.tensors.schema import RecordSchema
+
+
+class CycleError(RuntimeError):
+    """The graph is cyclic — a topological order does not exist.
+
+    Carries the offending transformation names so the failure is
+    actionable at plan time (the analyzer surfaces it as an ERROR
+    diagnostic; the runtime raises it before any subtask starts).
+    """
+
+    def __init__(self, cycle_names: typing.Sequence[str]):
+        self.cycle_names = list(cycle_names)
+        super().__init__(
+            "dataflow graph contains a cycle: " + " -> ".join(self.cycle_names)
+        )
 
 
 @dataclasses.dataclass
@@ -33,6 +49,13 @@ class Transformation:
     parallelism: int
     inputs: typing.List[Edge] = dataclasses.field(default_factory=list)
     is_source: bool = False
+    #: Plan-time schema contract (analysis-only; the runtime ignores both):
+    #: sources declare the schema of the records they emit ...
+    declared_schema: typing.Optional["RecordSchema"] = None
+    #: ... and downstream operators declare how they transform it —
+    #: ``schema_fn(input_schema) -> output_schema`` (None = unknown, which
+    #: stops propagation past this node without failing it).
+    schema_fn: typing.Optional[typing.Callable] = None
 
     def __hash__(self) -> int:
         return self.id
@@ -54,6 +77,8 @@ class DataflowGraph:
         parallelism: int,
         inputs: typing.Optional[typing.List[Edge]] = None,
         is_source: bool = False,
+        declared_schema: typing.Optional["RecordSchema"] = None,
+        schema_fn: typing.Optional[typing.Callable] = None,
     ) -> Transformation:
         if parallelism <= 0:
             raise ValueError(f"parallelism must be positive, got {parallelism}")
@@ -73,25 +98,42 @@ class DataflowGraph:
             parallelism=parallelism,
             inputs=list(inputs or []),
             is_source=is_source,
+            declared_schema=declared_schema,
+            schema_fn=schema_fn,
         )
         self._next_id += 1
         self.transformations.append(t)
         return t
 
     def topological_order(self) -> typing.List[Transformation]:
-        order: typing.List[Transformation] = []
-        visited: typing.Set[int] = set()
+        """Upstream-before-downstream order.
 
-        def visit(t: Transformation) -> None:
-            if t.id in visited:
+        Raises :class:`CycleError` (naming the nodes on the cycle) on
+        cyclic input — a silently wrong order here would wire channels
+        that deadlock or drop records at runtime.
+        """
+        order: typing.List[Transformation] = []
+        done: typing.Set[int] = set()
+        on_path: typing.Set[int] = set()
+
+        def visit(t: Transformation, path: typing.List[Transformation]) -> None:
+            if t.id in done:
                 return
-            visited.add(t.id)
+            if t.id in on_path:
+                # Trim the path to the cycle proper and close the loop.
+                start = next(i for i, p in enumerate(path) if p.id == t.id)
+                raise CycleError([p.name for p in path[start:]] + [t.name])
+            on_path.add(t.id)
+            path.append(t)
             for edge in t.inputs:
-                visit(edge.upstream)
+                visit(edge.upstream, path)
+            path.pop()
+            on_path.discard(t.id)
+            done.add(t.id)
             order.append(t)
 
         for t in self.transformations:
-            visit(t)
+            visit(t, [])
         return order
 
     def downstream_of(self, t: Transformation) -> typing.List[Transformation]:

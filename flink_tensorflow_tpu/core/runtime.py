@@ -22,7 +22,7 @@ import typing
 
 from flink_tensorflow_tpu.core import elements as el
 from flink_tensorflow_tpu.core.channels import ChannelWriter, InputGate
-from flink_tensorflow_tpu.core.graph import DataflowGraph, Transformation
+from flink_tensorflow_tpu.core.graph import CycleError, DataflowGraph, Transformation
 from flink_tensorflow_tpu.core.operators import Operator, Output, SourceOperator
 from flink_tensorflow_tpu.core.partitioning import ForwardPartitioner
 from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
@@ -268,7 +268,16 @@ class LocalExecutor:
         by_transformation: typing.Dict[int, typing.List[_Subtask]] = {}
         gates: typing.Dict[typing.Tuple[int, int], InputGate] = {}
 
-        order = self.graph.topological_order()
+        try:
+            order = self.graph.topological_order()
+        except CycleError:
+            logger.error(
+                "cannot build the physical plan: the dataflow graph is "
+                "cyclic — run the plan analyzer (env.validate_plan() or "
+                "`python -m flink_tensorflow_tpu.analysis <pipeline>`) "
+                "for full diagnostics"
+            )
+            raise
 
         from flink_tensorflow_tpu.core.partitioning import HashPartitioner
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import typing
 
 from flink_tensorflow_tpu.core import functions as fn
-from flink_tensorflow_tpu.core.graph import DataflowGraph, Edge, Transformation
+from flink_tensorflow_tpu.core.graph import Edge, Transformation
 from flink_tensorflow_tpu.core.operators import (
     FilterOperator,
     FlatMapOperator,
@@ -64,6 +64,22 @@ def _count_trigger(size: int, slide: typing.Optional[int],
 
 if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.core.environment import StreamExecutionEnvironment
+
+
+def _schema_fn(explicit, func):
+    """Plan-time schema transform for an operator: the explicit
+    ``output_schema=`` argument wins (a RecordSchema constant or a
+    ``input_schema -> output_schema`` callable); otherwise the function's
+    optional ``output_schema`` hook.  None = unknown (propagation stops
+    at the node without failing it)."""
+    if explicit is not None:
+        return explicit
+    return getattr(func, "output_schema", None)
+
+
+def _identity_schema(s):
+    """Schema transform of operators that forward records unchanged."""
+    return s
 
 
 class _LambdaMap(fn.MapFunction):
@@ -135,31 +151,40 @@ class DataStream:
                 p = RebalancePartitioner()
         return Edge(upstream=self.transformation, partitioner=p)
 
-    def _add_op(self, name, factory, parallelism) -> Transformation:
+    def _add_op(self, name, factory, parallelism, schema_fn=None) -> Transformation:
         parallelism = parallelism or self.env.default_parallelism
         return self.env.graph.add(
-            name, factory, parallelism, inputs=[self._edge(parallelism)]
+            name, factory, parallelism, inputs=[self._edge(parallelism)],
+            schema_fn=schema_fn,
         )
 
     # -- transforms -------------------------------------------------------
-    def map(self, f: typing.Union[fn.MapFunction, typing.Callable], *, name="map", parallelism=None) -> "DataStream":
+    def map(self, f: typing.Union[fn.MapFunction, typing.Callable], *, name="map",
+            parallelism=None, output_schema=None) -> "DataStream":
         func = (f if isinstance(f, (fn.MapFunction, fn.AsyncMapFunction))
                 else _LambdaMap(f))
-        t = self._add_op(name, lambda: MapOperator(name, func), parallelism)
+        t = self._add_op(name, lambda: MapOperator(name, func), parallelism,
+                         schema_fn=_schema_fn(output_schema, func))
         return DataStream(self.env, t)
 
-    def flat_map(self, f, *, name="flat_map", parallelism=None) -> "DataStream":
+    def flat_map(self, f, *, name="flat_map", parallelism=None,
+                 output_schema=None) -> "DataStream":
         func = f if isinstance(f, fn.FlatMapFunction) else _LambdaFlatMap(f)
-        t = self._add_op(name, lambda: FlatMapOperator(name, func), parallelism)
+        t = self._add_op(name, lambda: FlatMapOperator(name, func), parallelism,
+                         schema_fn=_schema_fn(output_schema, func))
         return DataStream(self.env, t)
 
     def filter(self, f, *, name="filter", parallelism=None) -> "DataStream":
         func = f if isinstance(f, fn.FilterFunction) else _LambdaFilter(f)
-        t = self._add_op(name, lambda: FilterOperator(name, func), parallelism)
+        # A filter drops records but never reshapes them.
+        t = self._add_op(name, lambda: FilterOperator(name, func), parallelism,
+                         schema_fn=_identity_schema)
         return DataStream(self.env, t)
 
-    def process(self, f: fn.ProcessFunction, *, name="process", parallelism=None) -> "DataStream":
-        t = self._add_op(name, lambda: ProcessOperator(name, f), parallelism)
+    def process(self, f: fn.ProcessFunction, *, name="process", parallelism=None,
+                output_schema=None) -> "DataStream":
+        t = self._add_op(name, lambda: ProcessOperator(name, f), parallelism,
+                         schema_fn=_schema_fn(output_schema, f))
         return DataStream(self.env, t)
 
     # -- partitioning -----------------------------------------------------
@@ -180,7 +205,8 @@ class DataStream:
         the first stream anywhere a single upstream edge is built."""
         merged = _UnionStream(self.env, [self, *others])
         return merged.map(lambda v: v, name="union",
-                          parallelism=self.transformation.parallelism)
+                          parallelism=self.transformation.parallelism,
+                          output_schema=_identity_schema)
 
     def side_output(self, tag: str) -> "DataStream":
         """Tap a named side output (e.g. the late-data stream of an
@@ -226,6 +252,7 @@ class DataStream:
             lambda: TimestampAssignerOperator(name, ts_fn, out_of_orderness_s,
                                               watermark_every),
             self.transformation.parallelism,
+            schema_fn=_identity_schema,
         )
         return DataStream(self.env, t)
 
@@ -289,10 +316,11 @@ class _UnionStream(DataStream):
         super().__init__(env, streams[0].transformation)
         self._streams = streams
 
-    def _add_op(self, name, factory, parallelism):
+    def _add_op(self, name, factory, parallelism, schema_fn=None):
         parallelism = parallelism or self.env.default_parallelism
         edges = [s._edge(parallelism) for s in self._streams]
-        return self.env.graph.add(name, factory, parallelism, inputs=edges)
+        return self.env.graph.add(name, factory, parallelism, inputs=edges,
+                                  schema_fn=schema_fn)
 
 
 class KeyedStream:
@@ -309,13 +337,15 @@ class KeyedStream:
             HashPartitioner(self.key_selector, self.env.config.max_parallelism),
         )
 
-    def process(self, f: fn.ProcessFunction, *, name="keyed_process", parallelism=None) -> DataStream:
+    def process(self, f: fn.ProcessFunction, *, name="keyed_process", parallelism=None,
+                output_schema=None) -> DataStream:
         parallelism = parallelism or self.env.default_parallelism
         t = self.env.graph.add(
             name,
             lambda: ProcessOperator(name, f, key_selector=self.key_selector),
             parallelism,
             inputs=[self._edge()],
+            schema_fn=_schema_fn(output_schema, f),
         )
         return DataStream(self.env, t)
 
@@ -440,6 +470,7 @@ class EventTimeWindowedStream:
                                             allowed_lateness_s=allowed_lateness_s),
             parallelism,
             inputs=[edge],
+            schema_fn=_schema_fn(None, f),
         )
         return _with_side_outputs(self.env, t, name, parallelism, late_tag)
 
@@ -469,6 +500,7 @@ class SessionWindowedStream:
                                           late_tag=late_tag),
             parallelism,
             inputs=[edge],
+            schema_fn=_schema_fn(None, f),
         )
         return _with_side_outputs(self.env, t, name, parallelism, late_tag)
 
@@ -480,7 +512,8 @@ class WindowedStream:
         self.trigger = trigger
         self.key_selector = key_selector
 
-    def apply(self, f: fn.WindowFunction, *, name="window", parallelism=None) -> DataStream:
+    def apply(self, f: fn.WindowFunction, *, name="window", parallelism=None,
+              output_schema=None) -> DataStream:
         parallelism = parallelism or self.env.default_parallelism
         if isinstance(self.upstream, KeyedStream):
             edge = self.upstream._edge()
@@ -491,6 +524,7 @@ class WindowedStream:
             lambda: WindowOperator(name, f, self.trigger, key_selector=self.key_selector),
             parallelism,
             inputs=[edge],
+            schema_fn=_schema_fn(output_schema, f),
         )
         return DataStream(self.env, t)
 
@@ -507,6 +541,7 @@ def _with_side_outputs(env, raw_t, name, parallelism, late_tag):
     main = stream.flat_map(
         lambda v: [] if isinstance(v, el.SideOutput) else [v],
         name=f"{name}:main", parallelism=parallelism,
+        output_schema=_identity_schema,
     )
     main._side_source = raw_t
     return main
@@ -538,22 +573,25 @@ class ConnectedStreams:
             ]
         return [self.s1._edge(parallelism), self.s2._edge(parallelism)]
 
-    def _add(self, name, factory, parallelism):
+    def _add(self, name, factory, parallelism, schema_fn=None):
         parallelism = parallelism or self.env.default_parallelism
         t = self.env.graph.add(name, factory, parallelism,
-                               inputs=self._edges(parallelism))
+                               inputs=self._edges(parallelism),
+                               schema_fn=schema_fn)
         return DataStream(self.env, t)
 
     def map(self, f: "fn.CoMapFunction", *, name="co_map", parallelism=None) -> DataStream:
         from flink_tensorflow_tpu.core.operators import CoMapOperator
 
-        return self._add(name, lambda: CoMapOperator(name, f), parallelism)
+        return self._add(name, lambda: CoMapOperator(name, f), parallelism,
+                         schema_fn=_schema_fn(None, f))
 
     def flat_map(self, f: "fn.CoFlatMapFunction", *, name="co_flat_map",
                  parallelism=None) -> DataStream:
         from flink_tensorflow_tpu.core.operators import CoFlatMapOperator
 
-        return self._add(name, lambda: CoFlatMapOperator(name, f), parallelism)
+        return self._add(name, lambda: CoFlatMapOperator(name, f), parallelism,
+                         schema_fn=_schema_fn(None, f))
 
     def process(self, f: "fn.CoProcessFunction", *, name="co_process",
                 parallelism=None) -> DataStream:
@@ -565,6 +603,7 @@ class ConnectedStreams:
                                       key_selector1=self.key_selector1,
                                       key_selector2=self.key_selector2),
             parallelism,
+            schema_fn=_schema_fn(None, f),
         )
 
 
@@ -611,6 +650,7 @@ class JoinBuilder:
                                        self._key1, self._key2),
             parallelism,
             inputs=edges,
+            schema_fn=_schema_fn(None, func),
         )
         return DataStream(self.env, t)
 
@@ -639,5 +679,6 @@ class IntervalJoinBuilder:
             ),
             parallelism,
             inputs=[self.left._edge(), self.right._edge()],
+            schema_fn=_schema_fn(None, func),
         )
         return DataStream(self.env, t)

@@ -54,6 +54,10 @@ def _resolve(source: ModelSource) -> Model:
 
 
 class _ModelFunctionBase(fn.RichFunction):
+    #: Plan-analyzer marker: records entering this function cross into
+    #: jitted, static-shape code (see flink_tensorflow_tpu.analysis).
+    is_jit_boundary = True
+
     def __init__(
         self,
         model: ModelSource,
@@ -80,6 +84,37 @@ class _ModelFunctionBase(fn.RichFunction):
         self._stamp_stages = stamp_stages
         self.runner: typing.Optional[CompiledMethodRunner] = None
         self._out: typing.Optional[fn.Collector] = None
+
+    # -- plan-time hooks (no model load, no device work) ------------------
+    def plan_input_schema(self):
+        """The model method's input RecordSchema when it is knowable
+        without loading anything: only for an already-resolved Model.
+        Lazy sources (bundle paths, loaders, factories) return None —
+        the analyzer treats the contract as unknown rather than paying
+        a load at plan time."""
+        if isinstance(self._source, Model):
+            try:
+                return self._source.method(self._method_name).input_schema
+            except KeyError:
+                return None
+        return None
+
+    def output_schema(self, input_schema):
+        """Plan-analyzer hook: validate the incoming record schema
+        against the model method's declared inputs.  Output shapes are
+        not knowable without compiling, so propagation stops here
+        (returns None)."""
+        from flink_tensorflow_tpu.tensors.schema import check_compatible
+
+        expected = self.plan_input_schema()
+        if expected is not None and input_schema is not None:
+            check_compatible(expected, input_schema,
+                             where=f"model method {self._method_name!r}")
+        return None
+
+    def plan_policy(self):
+        """The bucket policy the runner will resolve at open()."""
+        return self._policy or BucketPolicy()
 
     def service_time_estimate(self) -> typing.Optional[float]:
         """EWMA of the per-batch service time (dispatch -> results on
@@ -569,6 +604,9 @@ class _GraphFunctionBase(fn.RichFunction):
     policy is forced to the artifact's batch size.
     """
 
+    #: Plan-analyzer marker (see _ModelFunctionBase).
+    is_jit_boundary = True
+
     def __init__(self, graph: typing.Union[str, bytes], *, batch: int,
                  input_schema, needs_lengths: bool = False,
                  length_bucket: int = 128):
@@ -591,6 +629,20 @@ class _GraphFunctionBase(fn.RichFunction):
         dup = copy.copy(self)
         dup._call = None
         return dup
+
+    # -- plan-time hooks ---------------------------------------------------
+    def output_schema(self, input_schema):
+        """Validate against the artifact's declared input schema; output
+        shapes live inside the serialized StableHLO — unknown here."""
+        from flink_tensorflow_tpu.tensors.schema import check_compatible
+
+        if input_schema is not None:
+            check_compatible(self._schema, input_schema,
+                             where="frozen graph inputs")
+        return None
+
+    def plan_policy(self):
+        return self._policy
 
     def open(self, ctx) -> None:
         self._call = GraphLoader(self._graph_source).load()

@@ -31,7 +31,7 @@ from flink_tensorflow_tpu.core import functions as fn
 from flink_tensorflow_tpu.models.zoo.registry import ModelDef
 from flink_tensorflow_tpu.tensors.batching import BucketPolicy, assemble
 from flink_tensorflow_tpu.tensors.coercion import coerce
-from flink_tensorflow_tpu.tensors.schema import RecordSchema
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, check_compatible
 from flink_tensorflow_tpu.tensors.value import TensorValue
 
 
@@ -101,6 +101,9 @@ class OnlineTrainFunction(fn.ProcessFunction):
     ``TensorValue({"loss": ..., "step": ...}, meta={"key": key})``.
     """
 
+    #: Plan-analyzer marker: records feed a jitted train step.
+    is_jit_boundary = True
+
     def __init__(
         self,
         model_def: ModelDef,
@@ -169,6 +172,19 @@ class OnlineTrainFunction(fn.ProcessFunction):
         dup._steps = {}
         dup._out = None
         return dup
+
+    # -- plan-time hooks ---------------------------------------------------
+    def output_schema(self, input_schema):
+        """Plan-analyzer hook: incoming records must satisfy the train
+        schema; the emitted per-step metrics records have a different,
+        model-dependent shape — propagation stops here."""
+        if input_schema is not None:
+            check_compatible(self.train_schema, input_schema,
+                             where="train_schema")
+        return None
+
+    def plan_policy(self):
+        return self._policy
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, ctx) -> None:
@@ -375,6 +391,12 @@ class DPTrainWindowFunction(fn.WindowFunction):
     triggers; see examples/multihost_dp_train.py).
     """
 
+    #: Plan-analyzer markers: a jitted step, and a GANG — stream
+    #: parallelism 1 owning the whole mesh (the mesh-divisibility lint
+    #: checks global_batch against the mesh's data axis at plan time).
+    is_jit_boundary = True
+    is_gang = True
+
     def __init__(
         self,
         model_def: ModelDef,
@@ -413,6 +435,16 @@ class DPTrainWindowFunction(fn.WindowFunction):
         dup._state = None
         dup._pending = None
         return dup
+
+    # -- plan-time hooks ---------------------------------------------------
+    def output_schema(self, input_schema):
+        if input_schema is not None:
+            check_compatible(self.train_schema, input_schema,
+                             where="train_schema")
+        return None
+
+    def plan_policy(self):
+        return self._policy
 
     def open(self, ctx) -> None:
         import jax

@@ -20,7 +20,7 @@ import numpy as np
 
 from flink_tensorflow_tpu.models.base import ModelMethod
 from flink_tensorflow_tpu.models.zoo.registry import ModelDef, register_model_def
-from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec, spec
+from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec
 
 
 class BiLSTMClassifier(nn.Module):

@@ -20,7 +20,7 @@ import logging
 import os
 import typing
 
-from flink_tensorflow_tpu.parallel.mesh import AXIS_ORDER, MeshSpec
+from flink_tensorflow_tpu.parallel.mesh import MeshSpec
 
 logger = logging.getLogger(__name__)
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import functools
 import math
-import typing
 
 from flink_tensorflow_tpu.parallel.mesh import SEQ_AXIS
 

@@ -34,7 +34,6 @@ def ulysses_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     q/k/v: the local shard ``[B, T_local, H, D]`` with ``H`` divisible by
     the axis size.  Returns the local output shard, q's dtype.
     """
-    import jax.numpy as jnp
     from jax import lax
 
     n = lax.axis_size(axis_name)

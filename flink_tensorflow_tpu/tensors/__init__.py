@@ -12,7 +12,13 @@ from flink_tensorflow_tpu.tensors.batching import (
     assemble,
 )
 from flink_tensorflow_tpu.tensors.coercion import coerce, coerce_field, image_to_float, register_converter
-from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec, spec
+from flink_tensorflow_tpu.tensors.schema import (
+    RecordSchema,
+    SchemaMismatch,
+    TensorSpec,
+    check_compatible,
+    spec,
+)
 from flink_tensorflow_tpu.tensors.transfer import DeviceTransfer
 from flink_tensorflow_tpu.tensors.value import TensorValue
 
@@ -22,9 +28,11 @@ __all__ = [
     "BucketPolicy",
     "DeviceTransfer",
     "RecordSchema",
+    "SchemaMismatch",
     "TensorSpec",
     "TensorValue",
     "assemble",
+    "check_compatible",
     "coerce",
     "coerce_field",
     "image_to_float",

@@ -84,6 +84,14 @@ class RecordSchema:
     def __eq__(self, other) -> bool:
         return isinstance(other, RecordSchema) and self.fields == other.fields
 
+    def __hash__(self) -> int:
+        # Consistent with __eq__ (dict equality is order-insensitive, so
+        # the hash must be too).  TensorSpec is a frozen dataclass and
+        # hashes by (shape, dtype).  Without this, defining __eq__ alone
+        # made schemas unhashable — no set/dict membership, which the
+        # plan analyzer needs to count distinct shape signatures.
+        return hash(frozenset(self.fields.items()))
+
     def __repr__(self) -> str:
         inner = ", ".join(f"{k}: {v.shape}/{v.dtype}" for k, v in self.fields.items())
         return f"RecordSchema({inner})"
@@ -127,3 +135,47 @@ class RecordSchema:
 def spec(shape, dtype=np.float32) -> TensorSpec:
     """Shorthand constructor: ``spec((224, 224, 3), np.uint8)``."""
     return TensorSpec(tuple(shape), dtype)
+
+
+class SchemaMismatch(TypeError):
+    """Two record schemas disagree (field set, rank, dtype, or a static
+    dim).  Raised by plan-time ``output_schema`` hooks; the analyzer
+    turns it into an ERROR diagnostic at the exact edge it occurred."""
+
+
+def check_compatible(
+    expected: RecordSchema, actual: RecordSchema, *, where: str = ""
+) -> None:
+    """Check that records described by ``actual`` satisfy ``expected``.
+
+    Every expected field must be present with equal rank and dtype, and
+    equal static dims; a ``None`` (dynamic) dim on either side matches
+    anything.  Extra fields in ``actual`` are allowed — operators read
+    the fields they declare and pass the rest through.  Raises
+    :class:`SchemaMismatch` with a field-level message.
+    """
+    ctx = f" at {where}" if where else ""
+    missing = [n for n in expected.names if n not in actual]
+    if missing:
+        raise SchemaMismatch(
+            f"missing field(s) {missing}{ctx}: expected {expected}, got {actual}"
+        )
+    for name in expected.names:
+        want, got = expected[name], actual[name]
+        if want.rank != got.rank:
+            raise SchemaMismatch(
+                f"rank mismatch for field {name!r}{ctx}: expected "
+                f"{want.shape} (rank {want.rank}), got {got.shape} "
+                f"(rank {got.rank})"
+            )
+        if want.dtype != got.dtype:
+            raise SchemaMismatch(
+                f"dtype mismatch for field {name!r}{ctx}: expected "
+                f"{want.dtype}, got {got.dtype}"
+            )
+        for axis, (w, g) in enumerate(zip(want.shape, got.shape)):
+            if w is not None and g is not None and w != g:
+                raise SchemaMismatch(
+                    f"shape mismatch for field {name!r} axis {axis}{ctx}: "
+                    f"expected {want.shape}, got {got.shape}"
+                )

@@ -1,0 +1,435 @@
+"""Plan-time analyzer: lint-rule matrix, schema propagation, and the
+ISSUE-1 acceptance criteria (five clean examples via the CLI; a
+mis-schemaed pipeline yielding exactly one ERROR naming its edge)."""
+
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.analysis import (
+    PlanValidationError,
+    Severity,
+    analyze,
+    capture_plan,
+    edge_name,
+    format_diagnostics,
+)
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.graph import CycleError, DataflowGraph, Edge
+from flink_tensorflow_tpu.core.operators import MapOperator, ProcessOperator
+from flink_tensorflow_tpu.core.partitioning import (
+    ForwardPartitioner,
+    RebalancePartitioner,
+)
+from flink_tensorflow_tpu.tensors import RecordSchema, spec
+from flink_tensorflow_tpu.tensors.batching import BucketLadder, BucketPolicy
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+EXAMPLES = [
+    "examples/mnist_lenet.py",
+    "examples/widedeep_online.py",
+    "examples/bilstm_stream.py",
+    "examples/resnet_dp_train.py",
+    "examples/inception_inference.py",
+]
+
+
+def by_rule(diags, rule_id):
+    return [d for d in diags if d.rule == rule_id]
+
+
+def errors(diags):
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+class _IdMap(fn.MapFunction):
+    def map(self, value):
+        return value
+
+
+class _Proc(fn.ProcessFunction):
+    def process_element(self, value, ctx, out):
+        out.collect(value)
+
+
+class _StubJitWindowFn(fn.WindowFunction):
+    """Minimal jit-boundary window function for lint-rule tests."""
+
+    is_jit_boundary = True
+
+    def __init__(self, policy=None):
+        self._policy = policy
+
+    def process_window(self, key, window, elements, out):
+        for e in elements:
+            out.collect(e)
+
+
+class _StubGangFn(_StubJitWindowFn):
+    is_gang = True
+
+    def __init__(self, global_batch, policy=None):
+        super().__init__(policy)
+        self.global_batch = global_batch
+
+
+class _FakeMesh:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+SCHEMA_F32 = RecordSchema({"x": spec((4,), np.float32)})
+SCHEMA_I32 = RecordSchema({"x": spec((4,), np.int32)})
+
+
+def clean_env(parallelism=1):
+    env = StreamExecutionEnvironment(parallelism=parallelism)
+    (env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+        .map(_IdMap(), output_schema=lambda s: s)
+        .filter(lambda v: True)
+        .sink_to_list())
+    return env
+
+
+class TestCycleDetection:
+    def test_topological_order_raises_with_names(self):
+        g = DataflowGraph()
+        g.add("src", lambda: None, 1, is_source=True)
+        b = g.add("b", lambda: None, 1)
+        c = g.add("c", lambda: None, 1)
+        b.inputs.append(Edge(c, RebalancePartitioner()))
+        c.inputs.append(Edge(b, RebalancePartitioner()))
+        with pytest.raises(CycleError) as exc:
+            g.topological_order()
+        assert "b" in exc.value.cycle_names and "c" in exc.value.cycle_names
+
+    def test_acyclic_order_unchanged(self):
+        env = clean_env()
+        order = env.graph.topological_order()
+        assert [t.name for t in order] == ["collection", "map", "filter", "collect"]
+
+    def test_cycle_is_sole_error_diagnostic(self):
+        g = DataflowGraph()
+        b = g.add("b", lambda: None, 1)
+        c = g.add("c", lambda: None, 1)
+        b.inputs.append(Edge(c, RebalancePartitioner()))
+        c.inputs.append(Edge(b, RebalancePartitioner()))
+        diags = analyze(g)
+        assert len(diags) == 1 and diags[0].rule == "cycle"
+        assert diags[0].severity == Severity.ERROR
+
+    def test_runtime_build_raises_cycle_error(self):
+        env = StreamExecutionEnvironment()
+        s = env.from_collection([1])
+        m = s.map(_IdMap()).transformation
+        # Hand-wire a back edge (the fluent API cannot build one).
+        m.inputs.append(Edge(m, RebalancePartitioner()))
+        with pytest.raises(CycleError):
+            env.execute("cyclic")
+
+
+class TestSchemaHashability:
+    def test_hash_consistent_with_eq(self):
+        a = RecordSchema({"x": spec((4,)), "y": spec((), np.int32)})
+        b = RecordSchema({"y": spec((), np.int32), "x": spec((4,))})
+        assert a == b and hash(a) == hash(b)
+        assert len({a, b}) == 1
+
+    def test_distinct_schemas_distinct_in_sets(self):
+        assert len({SCHEMA_F32, SCHEMA_I32}) == 2
+
+
+class TestLintRules:
+    def test_clean_pipeline_no_diagnostics(self):
+        env = clean_env()
+        assert analyze(env.graph, config=env.config) == []
+
+    def test_dangling_root(self):
+        env = clean_env()
+        env.graph.add("orphan", lambda: MapOperator("orphan", _IdMap()), 1)
+        diags = by_rule(analyze(env.graph), "dangling-root")
+        assert len(diags) == 1 and diags[0].node == "orphan"
+        assert diags[0].severity == Severity.ERROR
+
+    def test_keyed_partitioning(self):
+        env = StreamExecutionEnvironment()
+        src = env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+        env.graph.add(
+            "keyed",
+            lambda: ProcessOperator("keyed", _Proc(), key_selector=lambda v: v),
+            1,
+            inputs=[Edge(src.transformation, RebalancePartitioner())],
+        )
+        diags = by_rule(analyze(env.graph), "keyed-partitioning")
+        assert len(diags) == 1
+        assert diags[0].edge == edge_name("collection", "keyed")
+
+    def test_keyed_partitioning_clean_via_key_by(self):
+        env = StreamExecutionEnvironment()
+        env.from_collection([1, 2, 3]).key_by(lambda v: v).process(_Proc())
+        assert by_rule(analyze(env.graph), "keyed-partitioning") == []
+
+    def test_forward_parallelism(self):
+        env = StreamExecutionEnvironment()
+        src = env.from_collection([1, 2, 3])
+        env.graph.add(
+            "fwd", lambda: MapOperator("fwd", _IdMap()), 4,
+            inputs=[Edge(src.transformation, ForwardPartitioner())],
+        )
+        diags = by_rule(analyze(env.graph), "forward-parallelism")
+        assert len(diags) == 1
+        assert diags[0].edge == edge_name("collection", "fwd")
+
+    def test_keyed_parallelism_bound(self):
+        env = StreamExecutionEnvironment()
+        env.configure(max_parallelism=2)
+        env.from_collection([1, 2, 3]).key_by(lambda v: v).process(
+            _Proc(), parallelism=3)
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "keyed-parallelism-bound")
+        assert len(diags) == 1 and "max_parallelism 2" in diags[0].message
+        # Without a config the rule cannot know the bound and stays quiet.
+        assert by_rule(analyze(env.graph), "keyed-parallelism-bound") == []
+
+    def test_gang_parallelism_and_missing_mesh(self):
+        env = StreamExecutionEnvironment(parallelism=2)
+        (env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+            .count_window(4)
+            .apply(_StubGangFn(global_batch=4), name="gang", parallelism=2))
+        msgs = by_rule(analyze(env.graph, config=env.config), "mesh-divisibility")
+        assert any("parallelism 2" in d.message for d in msgs)
+        assert any("set_mesh" in d.message for d in msgs)
+
+    def test_mesh_divisibility(self):
+        env = StreamExecutionEnvironment()
+        env.set_mesh(_FakeMesh({"data": 3}))
+        (env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+            .count_window(4)
+            .apply(_StubGangFn(global_batch=4), name="gang"))
+        diags = by_rule(analyze(env.graph, config=env.config), "mesh-divisibility")
+        assert len(diags) == 1 and "does not divide" in diags[0].message
+
+    def test_mesh_divisibility_clean(self):
+        env = StreamExecutionEnvironment()
+        env.set_mesh(_FakeMesh({"data": 4}))
+        (env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+            .count_window(4)
+            .apply(_StubGangFn(global_batch=4), name="gang"))
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "mesh-divisibility") == []
+
+    def test_dynamic_jit_boundary_unbucketed_is_error(self):
+        env = StreamExecutionEnvironment()
+        dyn = RecordSchema({"tokens": spec((None,), np.int32)})
+        (env.from_collection([1], schema=dyn)
+            .count_window(4)
+            .apply(_StubJitWindowFn(policy=None), name="jit"))
+        diags = by_rule(analyze(env.graph), "dynamic-jit-boundary")
+        assert [d.severity for d in diags].count(Severity.ERROR) == 1
+        assert "tokens" in diags[0].message
+
+    def test_dynamic_jit_boundary_bucketed_is_info(self):
+        env = StreamExecutionEnvironment()
+        dyn = RecordSchema({"tokens": spec((None,), np.int32)})
+        policy = BucketPolicy(lengths=BucketLadder([64, 128]))
+        (env.from_collection([1], schema=dyn)
+            .count_window(4)
+            .apply(_StubJitWindowFn(policy=policy), name="jit"))
+        diags = by_rule(analyze(env.graph), "dynamic-jit-boundary")
+        assert len(diags) == 1 and diags[0].severity == Severity.INFO
+
+    def test_recompile_churn_on_mixed_signatures(self):
+        env = StreamExecutionEnvironment()
+        a = env.from_collection([1], schema=SCHEMA_F32, name="a")
+        b = env.from_collection([2], schema=SCHEMA_I32, name="b")
+        policy = BucketPolicy(fixed_batch=4)
+        (a.union(b)
+            .count_window(4)
+            .apply(_StubJitWindowFn(policy=policy), name="jit"))
+        diags = by_rule(analyze(env.graph), "recompile-churn")
+        assert len(diags) == 1 and "2 distinct schema signatures" in diags[0].message
+        assert diags[0].severity == Severity.WARN
+
+    def test_recompile_churn_window_without_policy(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1], schema=SCHEMA_F32)
+            .count_window(4, timeout_s=0.1)
+            .apply(_StubJitWindowFn(policy=None), name="jit"))
+        diags = by_rule(analyze(env.graph), "recompile-churn")
+        assert len(diags) == 1 and "no batch-bucket policy" in diags[0].message
+
+    def test_source_schema_unknown_is_info(self):
+        env = StreamExecutionEnvironment()
+        env.from_collection([1, 2, 3]).map(_IdMap()).sink_to_list()
+        diags = by_rule(analyze(env.graph), "source-schema-unknown")
+        assert len(diags) == 1 and diags[0].severity == Severity.INFO
+
+
+class TestSchemaPropagation:
+    """Propagation through map -> window -> model-function chains."""
+
+    @pytest.fixture(scope="class")
+    def lenet_model(self):
+        import jax
+
+        from flink_tensorflow_tpu.models import get_model_def
+
+        mdef = get_model_def("lenet")
+        return mdef.to_model(jax.jit(mdef.init_fn)(jax.random.key(0)))
+
+    def _pipeline(self, model, source_dtype=np.float32, map_hook=None):
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+
+        env = StreamExecutionEnvironment()
+        schema = RecordSchema({"image": spec((28, 28, 1), source_dtype)})
+        (env.from_collection([], schema=schema)
+            .map(_IdMap(), name="preprocess",
+                 output_schema=map_hook or (lambda s: s))
+            .count_window(8, timeout_s=0.02)
+            .apply(ModelWindowFunction(model), name="lenet")
+            .sink_to_list())
+        return env
+
+    def test_clean_chain_propagates_and_validates(self, lenet_model):
+        env = self._pipeline(lenet_model)
+        diags = analyze(env.graph, config=env.config)
+        assert errors(diags) == [], format_diagnostics(diags)
+
+    def test_dtype_mismatch_exactly_one_error_naming_edge(self, lenet_model):
+        """ISSUE-1 acceptance: a dtype mismatch injected at one edge
+        yields exactly ONE error, naming that edge."""
+        env = self._pipeline(lenet_model, source_dtype=np.uint8)
+        diags = analyze(env.graph, config=env.config)
+        errs = errors(diags)
+        assert len(errs) == 1
+        assert errs[0].rule == "schema-mismatch"
+        assert errs[0].edge == edge_name("preprocess", "lenet")
+        assert "dtype" in errs[0].message and "image" in errs[0].message
+
+    def test_map_hook_transform_is_applied(self, lenet_model):
+        # The map declares it converts uint8 -> float32: the chain is
+        # clean even though the source emits uint8.
+        to_f32 = lambda s: RecordSchema(  # noqa: E731
+            {n: spec(s[n].shape, np.float32) for n in s.names})
+        env = self._pipeline(lenet_model, source_dtype=np.uint8,
+                             map_hook=to_f32)
+        assert errors(analyze(env.graph)) == []
+
+    def test_rank_mismatch_detected(self, lenet_model):
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+
+        env = StreamExecutionEnvironment()
+        schema = RecordSchema({"image": spec((28, 28), np.float32)})
+        (env.from_collection([], schema=schema)
+            .count_window(8)
+            .apply(ModelWindowFunction(lenet_model), name="lenet"))
+        errs = errors(analyze(env.graph))
+        assert len(errs) == 1 and "rank" in errs[0].message
+
+    def test_missing_field_detected(self, lenet_model):
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+
+        env = StreamExecutionEnvironment()
+        schema = RecordSchema({"pixels": spec((28, 28, 1), np.float32)})
+        (env.from_collection([], schema=schema)
+            .count_window(8)
+            .apply(ModelWindowFunction(lenet_model), name="lenet"))
+        errs = errors(analyze(env.graph))
+        assert len(errs) == 1 and "missing field" in errs[0].message
+
+    def test_training_function_validates_train_schema(self):
+        from flink_tensorflow_tpu.functions import OnlineTrainFunction
+        from flink_tensorflow_tpu.models import get_model_def
+
+        cfg = dict(hash_buckets=16, embed_dim=2, num_cat_slots=2,
+                   num_dense=2, num_wide=4, hidden=(4,))
+        mdef = get_model_def("widedeep", **cfg)
+        train_schema = RecordSchema({
+            "wide": spec((4,)), "dense": spec((2,)),
+            "cat": spec((2,), np.int32), "label": spec((), np.int32),
+        })
+        bad_source = RecordSchema({
+            "wide": spec((4,)), "dense": spec((2,)),
+            "cat": spec((2,), np.float32),  # wrong dtype
+            "label": spec((), np.int32),
+        })
+        env = StreamExecutionEnvironment()
+        (env.from_collection([], schema=bad_source)
+            .key_by(lambda r: 0)
+            .process(OnlineTrainFunction(mdef, train_schema=train_schema),
+                     name="train"))
+        errs = errors(analyze(env.graph))
+        assert len(errs) == 1
+        assert errs[0].edge == edge_name("collection", "train")
+        assert "cat" in errs[0].message
+
+
+class TestValidateGate:
+    def test_execute_validate_true_raises_before_running(self):
+        env = StreamExecutionEnvironment()
+        env.graph.add("orphan", lambda: MapOperator("orphan", _IdMap()), 1)
+        with pytest.raises(PlanValidationError) as exc:
+            env.execute("bad", validate=True)
+        assert any(d.rule == "dangling-root" for d in exc.value.diagnostics)
+
+    def test_execute_validate_true_clean_job_runs(self):
+        env = StreamExecutionEnvironment()
+        out = (env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+               .map(_IdMap(), output_schema=lambda s: s)
+               .sink_to_list())
+        env.execute("good", validate=True, timeout=60)
+        assert sorted(out) == [1, 2, 3]
+
+    def test_validate_plan_returns_diagnostics_without_raising(self):
+        env = StreamExecutionEnvironment()
+        env.from_collection([1]).sink_to_list()
+        diags = env.validate_plan(raise_on_error=False)
+        assert all(d.severity != Severity.ERROR for d in diags)
+
+
+class TestCapture:
+    def test_capture_plan_returns_env_without_executing(self):
+        ran = []
+
+        def job():
+            env = StreamExecutionEnvironment()
+            env.from_collection([1, 2, 3], schema=SCHEMA_F32).sink_to_list()
+            env.execute("captured")
+            ran.append(True)  # must never run
+
+        env = capture_plan(job)
+        assert not ran
+        assert [t.name for t in env.graph.transformations] == [
+            "collection", "collect"]
+
+    def test_capture_plan_requires_execute(self):
+        with pytest.raises(RuntimeError, match="no plan to analyze"):
+            capture_plan(lambda: None)
+
+
+class TestCLIAcceptance:
+    def test_cli_clean_on_all_five_examples(self):
+        """ISSUE-1 acceptance: the CLI exits 0 (no ERROR diagnostics) on
+        each of the five example pipelines."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "flink_tensorflow_tpu.analysis", *EXAMPLES],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        for path in EXAMPLES:
+            assert path in proc.stdout
+        assert "ERROR" not in proc.stdout
+
+    def test_cli_nonzero_on_missing_file(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "flink_tensorflow_tpu.analysis",
+             "examples/does_not_exist.py"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2

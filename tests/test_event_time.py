@@ -1,7 +1,6 @@
 """Event-time windows, watermarks, keyed reduce — the Flink streaming
 semantics the reference jobs build on (SURVEY.md §1 L1)."""
 
-import numpy as np
 import pytest
 
 from flink_tensorflow_tpu import StreamExecutionEnvironment

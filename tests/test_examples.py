@@ -3,7 +3,6 @@ the five BASELINE.json configs as executable parity evidence."""
 
 import sys
 
-import pytest
 
 sys.path.insert(0, ".")
 

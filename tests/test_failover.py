@@ -3,9 +3,7 @@ reference's Flink-inherited failover semantics (SURVEY.md §5: heartbeats,
 restart strategies, region failover -> here: supervisor restart from the
 latest aligned snapshot)."""
 
-import threading
 
-import numpy as np
 import pytest
 
 from flink_tensorflow_tpu import StreamExecutionEnvironment

@@ -1,0 +1,57 @@
+"""Tier-1 lint guard: ruff over the repo, the plan analyzer over every
+example pipeline.
+
+Two layers of "clean":
+
+1. ``ruff check`` (config in pyproject.toml — pycodestyle/pyflakes/isort
+   rules) over the package, examples, and tests.  Skipped when ruff is
+   not installed in the environment (the container must not pip install;
+   CI images that carry ruff run it).
+2. The plan analyzer over all five example pipelines, in-process via
+   execute-capture: zero ERROR diagnostics, ever.  This is the guard
+   that keeps the examples' schema annotations and the analyzer's rules
+   honest against each other.
+"""
+
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+EXAMPLES = [
+    "examples/mnist_lenet.py",
+    "examples/widedeep_online.py",
+    "examples/bilstm_stream.py",
+    "examples/resnet_dp_train.py",
+    "examples/inception_inference.py",
+]
+
+
+@pytest.mark.skipif(shutil.which("ruff") is None,
+                    reason="ruff not installed in this environment")
+def test_ruff_clean():
+    proc = subprocess.run(
+        ["ruff", "check", "flink_tensorflow_tpu", "examples", "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize("pipeline", EXAMPLES)
+def test_examples_plan_has_no_error_diagnostics(pipeline):
+    from flink_tensorflow_tpu.analysis import (
+        Severity,
+        analyze,
+        capture_pipeline_file,
+        format_diagnostics,
+    )
+
+    env = capture_pipeline_file(str(REPO / pipeline))
+    diags = analyze(env.graph, config=env.config)
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    assert errors == [], format_diagnostics(diags)

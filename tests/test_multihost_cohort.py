@@ -8,11 +8,9 @@ the 2-process DP train forms a global 8-device mesh over jax.distributed
 remote record plane, and survives killing one process mid-training.
 """
 
-import json
 import os
 import sys
 import threading
-import time
 
 import numpy as np
 import pytest

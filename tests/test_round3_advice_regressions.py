@@ -19,7 +19,6 @@ import threading
 import time
 
 import numpy as np
-import pytest
 
 from flink_tensorflow_tpu.core import elements as el
 from flink_tensorflow_tpu.core.joins import (
