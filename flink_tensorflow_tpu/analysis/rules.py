@@ -9,8 +9,7 @@ device or model state is touched), the propagated schemas, and the
 job config when the caller provided one.
 
 Deferred (ROADMAP "Open items"): sharding-axis lints (NamedSharding
-annotations vs mesh axes) and watermark lints (event-time windows with
-no timestamp assigner upstream).
+annotations vs mesh axes).
 """
 
 from __future__ import annotations
@@ -264,6 +263,86 @@ def _dynamic_jit_boundary(ctx: AnalysisContext, emit: Emit) -> None:
                 f"length ladder {list(ladder.sizes)[:8]}",
                 node=t.name, severity=Severity.INFO,
             )
+
+
+@rule("watermark-missing-assigner", Severity.ERROR)
+def _watermark_missing_assigner(ctx: AnalysisContext, emit: Emit) -> None:
+    """Event-time window/session operators fire on watermarks and require
+    every record to carry an event timestamp: with no timestamp assigner
+    anywhere upstream the first record raises at runtime (and no
+    watermark would ever fire a window).  The runtime's watermark-lag
+    gauge (core/event_time) measures against the same provenance: the
+    assigner is where event time enters the stream."""
+    from flink_tensorflow_tpu.core.event_time import (
+        EventTimeWindowOperator,
+        SessionWindowOperator,
+        TimestampAssignerOperator,
+    )
+
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        if not isinstance(op, (EventTimeWindowOperator, SessionWindowOperator)):
+            continue
+        seen: typing.Set[int] = set()
+        stack = list(t.inputs)
+        found = False
+        while stack and not found:
+            upstream = stack.pop().upstream
+            if upstream.id in seen:
+                continue
+            seen.add(upstream.id)
+            if isinstance(ctx.operators.get(upstream.id), TimestampAssignerOperator):
+                found = True
+            else:
+                stack.extend(upstream.inputs)
+        if not found:
+            emit(
+                "event-time window has no timestamp assigner upstream — "
+                "records arrive without event timestamps and the operator "
+                "raises on the first one; add .assign_timestamps(ts_fn) "
+                "before the window",
+                node=t.name,
+            )
+
+
+@rule("watermark-async-flush", Severity.WARN)
+def _watermark_async_flush(ctx: AnalysisContext, emit: Emit) -> None:
+    """``watermark_every < micro_batch`` feeding an async map: the
+    enclosing operator flushes its in-flight micro-batch before
+    forwarding EVERY watermark (event-time safety — see MapOperator), so
+    fine-grained watermarks degrade transparent micro-batching toward
+    batch-of-1 dispatch.  Use ``watermark_every >= micro_batch`` so
+    flushes land on batch boundaries."""
+    from flink_tensorflow_tpu.core.event_time import TimestampAssignerOperator
+    from flink_tensorflow_tpu.core.functions import AsyncMapFunction
+
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        if not isinstance(op, TimestampAssignerOperator):
+            continue
+        seen: typing.Set[int] = set()
+        stack = ctx.graph.downstream_of(t)
+        while stack:
+            d = stack.pop()
+            if d.id in seen:
+                continue
+            seen.add(d.id)
+            dop = ctx.operators.get(d.id)
+            if isinstance(dop, TimestampAssignerOperator):
+                continue  # a later assigner re-times the stream below it
+            function = ctx.function_of(d)
+            micro = getattr(function, "_micro_batch", None)
+            if (isinstance(function, AsyncMapFunction) and micro
+                    and op.watermark_every < micro):
+                emit(
+                    f"assigner {t.name!r} emits a watermark every "
+                    f"{op.watermark_every} record(s) but this async map "
+                    f"micro-batches {micro} — each watermark flushes the "
+                    "partial batch, degrading dispatch toward batch-of-1; "
+                    f"use watermark_every >= {micro} (or shrink micro_batch)",
+                    node=d.name,
+                )
+            stack.extend(ctx.graph.downstream_of(d))
 
 
 @rule("recompile-churn", Severity.WARN)

@@ -135,6 +135,24 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def checkpoint_size_bytes(chk_path: str) -> int:
+    """On-disk footprint of one written checkpoint directory (state +
+    metadata) — feeds the coordinator's ``checkpoint.last_size_bytes``
+    gauge.  Called once per completed checkpoint, never on the record
+    path.  0 when the directory vanished (pruned concurrently)."""
+    total = 0
+    try:
+        for root, _, files in os.walk(chk_path):
+            for name in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, name))
+                except OSError:
+                    continue
+    except OSError:
+        return 0
+    return total
+
+
 def checkpoint_ids(base_dir: str) -> typing.List[int]:
     """All completed checkpoint ids under ``base_dir``, ascending."""
     if not os.path.isdir(base_dir):

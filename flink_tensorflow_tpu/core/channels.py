@@ -48,16 +48,47 @@ class InputGate:
         self._replay: typing.Deque[typing.Tuple[int, el.StreamElement]] = collections.deque()
         self._blocked: typing.List[bool] = [False] * num_channels
         self._closed = threading.Event()
+        # -- observability (metrics/: pull-based gauges read these) ------
+        #: Deepest queue occupancy ever observed at a put (monotone max;
+        #: updated without a lock — a lost race only understates it by
+        #: one sample, and the fast path must stay cheap).
+        self.high_watermark = 0
+        #: Total seconds writers spent blocked on a full queue — the
+        #: backpressure signal.  Guarded by ``_stats_lock``: the blocked
+        #: path is already slow, so a lock there costs nothing.
+        self.blocked_put_s = 0.0
+        self._stats_lock = threading.Lock()
+        #: Wake sentinels currently sitting in the queue — subtracted
+        #: from the depth gauge so they never read as buffered records.
+        self._wake_sentinels = 0
 
     # -- writer side ---------------------------------------------------
-    def put(self, channel_idx: int, element: el.StreamElement) -> None:
-        while not self._closed.is_set():
-            try:
-                self._queue.put((channel_idx, element), timeout=_POLL_INTERVAL_S)
-                return
-            except queue.Full:
-                continue
-        # Gate torn down (job cancelled/finished): drop silently.
+    def put(self, channel_idx: int, element: el.StreamElement) -> float:
+        """Enqueue; returns seconds spent blocked on a full queue (0.0 on
+        the uncontended fast path — callers attribute it to the WRITING
+        subtask's backpressure time)."""
+        try:
+            self._queue.put_nowait((channel_idx, element))
+        except queue.Full:
+            pass
+        else:
+            depth = self._queue.qsize()
+            if depth > self.high_watermark:
+                self.high_watermark = depth
+            return 0.0
+        t0 = _now()
+        try:
+            while not self._closed.is_set():
+                try:
+                    self._queue.put((channel_idx, element), timeout=_POLL_INTERVAL_S)
+                    return _now() - t0
+                except queue.Full:
+                    continue
+            # Gate torn down (job cancelled/finished): drop silently.
+            return _now() - t0
+        finally:
+            with self._stats_lock:
+                self.blocked_put_s += _now() - t0
 
     def wake(self) -> None:
         """Break a blocked :meth:`poll` immediately.
@@ -72,6 +103,8 @@ class InputGate:
             self._queue.put_nowait((-1, None))
         except queue.Full:
             pass  # a full queue wakes the reader on its own
+        else:
+            self._wake_sentinels += 1
 
     # -- reader side (single consumer thread) --------------------------
     def poll(self, timeout: typing.Optional[float] = None) -> typing.Optional[typing.Tuple[int, el.StreamElement]]:
@@ -92,6 +125,7 @@ class InputGate:
                     return None
                 continue
             if idx < 0:
+                self._wake_sentinels -= 1
                 return None  # wake() sentinel: hand control back NOW
             if self._blocked[idx]:
                 self._stashed[idx].append((idx, element))
@@ -115,6 +149,16 @@ class InputGate:
     def any_blocked(self) -> bool:
         return any(self._blocked)
 
+    @property
+    def depth(self) -> int:
+        """Elements currently buffered (queue + alignment stashes +
+        replay, minus un-consumed wake sentinels) — the queue-depth
+        gauge.  Approximate under concurrent mutation; reporters
+        tolerate off-by-a-few."""
+        return max(0, self._queue.qsize() + len(self._replay)
+                   + sum(len(d) for d in self._stashed)
+                   - self._wake_sentinels)
+
 
 def _now() -> float:
     import time
@@ -131,5 +175,7 @@ class ChannelWriter:
         self._gate = gate
         self._idx = idx
 
-    def write(self, element: el.StreamElement) -> None:
-        self._gate.put(self._idx, element)
+    def write(self, element: el.StreamElement) -> float:
+        """Forward to the gate; returns seconds the write spent blocked
+        (backpressure, attributed by Output to the writing subtask)."""
+        return self._gate.put(self._idx, element)

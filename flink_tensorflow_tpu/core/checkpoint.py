@@ -32,6 +32,9 @@ class _PendingCheckpoint:
         #: Count-based checkpoints have no trigger() caller waiting on
         #: them — persistence happens on completion, off the ack thread.
         self.source_initiated = source_initiated
+        #: Trigger time — end-to-end duration (through persistence) is
+        #: measured from here for the checkpoint.duration_s timer.
+        self.created_s = time.monotonic()
 
 
 class CheckpointCoordinator:
@@ -57,6 +60,22 @@ class CheckpointCoordinator:
     def __init__(self, executor: "LocalExecutor", checkpoint_dir: typing.Optional[str] = None):
         self.executor = executor
         self.checkpoint_dir = checkpoint_dir
+        #: Job-level checkpoint metrics under the "checkpoint" scope:
+        #: duration_s timer (trigger -> durable), completed counter, and
+        #: last-id/last-size gauges.  Per-subtask ALIGNMENT time lives on
+        #: each subtask's own scope (checkpoint_alignment_s, core/runtime).
+        #: Executor doubles without a registry get a private one — the
+        #: coordinator must work against the bare protocol it documents.
+        registry = getattr(executor, "metrics", None)
+        if registry is None:
+            from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+            registry = MetricRegistry()
+        self.metrics = registry.group("checkpoint")
+        self._last_checkpoint_id: typing.Optional[int] = None
+        self._last_size_bytes: typing.Optional[int] = None
+        self.metrics.gauge("last_checkpoint_id", lambda: self._last_checkpoint_id)
+        self.metrics.gauge("last_size_bytes", lambda: self._last_size_bytes)
         #: Distributed record plane: barriers may originate at sources on
         #: PEER processes, so the first local sighting of checkpoint k is
         #: an ack from a worker subtask, not begin_source_checkpoint —
@@ -168,10 +187,13 @@ class CheckpointCoordinator:
         if pending.failed:
             raise RuntimeError(f"checkpoint {cid} failed (job cancelled)")
         self._completed.append(cid)
+        chk_path = None
         if self.checkpoint_dir is not None:
             from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
-            write_checkpoint(self.checkpoint_dir, cid, self._with_job_meta(pending.snapshots))
+            chk_path = write_checkpoint(
+                self.checkpoint_dir, cid, self._with_job_meta(pending.snapshots))
+        self._record_completed(pending, chk_path)
         # Durable (or in-memory-complete): fire the commit signal for
         # two-phase sinks.  Durability-before-notify is the 2PC order.
         self.executor.notify_checkpoint_complete(cid)
@@ -213,6 +235,7 @@ class CheckpointCoordinator:
 
         if self.checkpoint_dir is None:
             def job():
+                self._record_completed(pending, None)
                 if self.commit_gate is not None and not self.commit_gate(
                         pending.checkpoint_id):
                     return
@@ -222,8 +245,9 @@ class CheckpointCoordinator:
                 from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
                 try:
-                    write_checkpoint(self.checkpoint_dir, pending.checkpoint_id,
-                                     self._with_job_meta(pending.snapshots))
+                    chk_path = write_checkpoint(
+                        self.checkpoint_dir, pending.checkpoint_id,
+                        self._with_job_meta(pending.snapshots))
                 except Exception:  # pragma: no cover - disk trouble
                     import logging
 
@@ -232,6 +256,7 @@ class CheckpointCoordinator:
                         exc_info=True,
                     )
                     return  # NOT durable: the 2PC commit signal must not fire
+                self._record_completed(pending, chk_path)
                 # Distributed jobs gate the commit signal on the checkpoint
                 # being durable on EVERY process — a locally-durable shard
                 # of a globally-incomplete checkpoint must not promote 2PC
@@ -251,6 +276,21 @@ class CheckpointCoordinator:
                 max_workers=1, thread_name_prefix="chk-persist"
             )
         self._persist_futures.append(self._persist_pool.submit(job))
+
+    def _record_completed(self, pending: _PendingCheckpoint,
+                          chk_path: typing.Optional[str]) -> None:
+        """Checkpoint bookkeeping metrics — once per completed checkpoint,
+        off the record path (trigger caller or persist worker)."""
+        self.metrics.timer("duration_s").update(
+            time.monotonic() - pending.created_s)
+        self.metrics.counter("completed").inc()
+        self._last_checkpoint_id = pending.checkpoint_id
+        if chk_path is not None:
+            from flink_tensorflow_tpu.checkpoint.store import (
+                checkpoint_size_bytes,
+            )
+
+            self._last_size_bytes = checkpoint_size_bytes(chk_path)
 
     def _prune(self) -> None:
         """Apply the retained-checkpoints policy (keep the newest N on

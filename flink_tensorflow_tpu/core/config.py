@@ -19,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 import typing
 
+from flink_tensorflow_tpu.metrics.reporters import MetricConfig
+
 
 @dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
@@ -110,6 +112,11 @@ class JobConfig:
     #: the shuffle).  None = single-process execution.  See
     #: core.distributed.DistributedConfig.
     distributed: typing.Optional[typing.Any] = None
+    #: Observability plane: reporter interval + sinks + registry seed
+    #: (metrics.reporters.MetricConfig).  The default publishes nothing
+    #: while the job runs — no reporter thread, metrics only in the
+    #: JobResult.
+    metrics: MetricConfig = dataclasses.field(default_factory=MetricConfig)
 
     def validate(self) -> "JobConfig":
         if self.parallelism < 1:
@@ -138,5 +145,6 @@ class JobConfig:
                     "(checkpoint.every_n_records), not interval_s — barrier "
                     "positions must be deterministic across the cohort"
                 )
+        self.metrics.validate()
         self.checkpoint.validate()
         return self

@@ -46,8 +46,11 @@ class RestartStrategy:
 class JobHandle:
     """Handle to an asynchronously running job."""
 
-    def __init__(self, executor: LocalExecutor):
+    def __init__(self, executor: LocalExecutor, reporter=None):
         self.executor = executor
+        #: metrics.reporters.ReporterThread when the job runs with a
+        #: report interval; None otherwise (no thread ever started).
+        self.reporter = reporter
 
     def trigger_checkpoint(self, timeout: typing.Optional[float] = None):
         """Run one aligned checkpoint; returns the snapshot mapping.
@@ -57,7 +60,14 @@ class JobHandle:
         return self.executor.coordinator.trigger(timeout=timeout)
 
     def wait(self, timeout: typing.Optional[float] = None) -> JobResult:
-        self.executor.join(timeout)
+        try:
+            self.executor.join(timeout)
+        finally:
+            # Stop on failure too: the final report + sink close land
+            # before the exception surfaces (last observations are often
+            # exactly what the failure post-mortem needs).
+            if self.reporter is not None:
+                self.reporter.stop()
         return JobResult(self.executor.metrics.report())
 
     def cancel(self) -> None:
@@ -66,6 +76,8 @@ class JobHandle:
         # writer; they are valid restore points, so cancel must not
         # abandon them (a caller typically restores right after).
         self.executor.coordinator.wait_for_persistence(60.0)
+        if self.reporter is not None:
+            self.reporter.stop()
 
     @property
     def metrics(self) -> MetricRegistry:
@@ -78,7 +90,7 @@ class StreamExecutionEnvironment:
         if config is not None and parallelism != 1:
             config = dataclasses.replace(config, parallelism=parallelism)
         self.config: JobConfig = config or JobConfig(parallelism=parallelism)
-        self.metric_registry = MetricRegistry()
+        self.metric_registry = MetricRegistry(seed=self.config.metrics.seed)
 
     # -- configuration ----------------------------------------------------
     # The typed JobConfig (core.config) is the single source of truth;
@@ -267,6 +279,9 @@ class StreamExecutionEnvironment:
 
     def _make_executor(self) -> LocalExecutor:
         cfg = self.config.validate()
+        # configure(metrics=...) may have changed the seed after the
+        # registry was created; histograms pick it up at first use.
+        self.metric_registry.seed = cfg.metrics.seed
         common = dict(
             channel_capacity=cfg.channel_capacity,
             metric_registry=self.metric_registry,
@@ -297,12 +312,20 @@ class StreamExecutionEnvironment:
         restore_checkpoint_id: typing.Optional[int] = None,
         restart_strategy: typing.Optional[RestartStrategy] = None,
         validate: bool = False,
+        report_interval_s: typing.Optional[float] = None,
     ) -> JobResult:
         """Run the job to completion on the local executor.
 
         ``validate=True`` runs the plan-time analyzer first and raises
         ``PlanValidationError`` on ERROR diagnostics — bad plans fail
         before touching a device (see flink_tensorflow_tpu.analysis).
+
+        ``report_interval_s`` publishes metrics while the job runs (a
+        daemon reporter thread feeding the sinks configured in
+        ``JobConfig.metrics`` — console by default; see
+        flink_tensorflow_tpu.metrics.reporters).  ``None`` (the default,
+        unless ``config.metrics.report_interval_s`` is set) starts no
+        thread at all.
 
         With a ``restart_strategy`` (requires ``enable_checkpointing``),
         failures restart the job from the latest persisted snapshot — the
@@ -316,6 +339,7 @@ class StreamExecutionEnvironment:
             handle = self.execute_async(
                 job_name, restore_from=restore_from,
                 restore_checkpoint_id=restore_checkpoint_id,
+                report_interval_s=report_interval_s,
             )
             return handle.wait(timeout)
 
@@ -342,7 +366,8 @@ class StreamExecutionEnvironment:
             remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
             try:
                 handle = self.execute_async(job_name, restore_from=restore,
-                                            restore_checkpoint_id=restore_id)
+                                            restore_checkpoint_id=restore_id,
+                                            report_interval_s=report_interval_s)
                 result = handle.wait(remaining)
                 result.restarts = attempt
                 return result
@@ -373,10 +398,12 @@ class StreamExecutionEnvironment:
         restore_from: typing.Optional[str] = None,
         restore_checkpoint_id: typing.Optional[int] = None,
         validate: bool = False,
+        report_interval_s: typing.Optional[float] = None,
     ) -> JobHandle:
         if validate:
             self.validate_plan()
         executor = self._make_executor()
+        reporter = self._make_reporter(report_interval_s)
         executor.checkpoint_interval_s = self.checkpoint_interval_s
         if restore_from is not None:
             from flink_tensorflow_tpu.checkpoint.store import read_checkpoint
@@ -438,4 +465,28 @@ class StreamExecutionEnvironment:
             executor.restore(snapshots, from_checkpoint_id=cid,
                              local_shard=local_shard)
         executor.start()
-        return JobHandle(executor)
+        if reporter is not None:
+            reporter.start()
+        return JobHandle(executor, reporter)
+
+    def _make_reporter(self, report_interval_s: typing.Optional[float]):
+        """Build (without starting) the job's ReporterThread, or None.
+
+        The interval resolves call-site argument first, then
+        ``config.metrics.report_interval_s``.  No interval -> no thread,
+        no sink construction — the documented zero-overhead default.
+        """
+        cfg = self.config.metrics
+        interval = (report_interval_s if report_interval_s is not None
+                    else cfg.report_interval_s)
+        if interval is None:
+            return None
+        from flink_tensorflow_tpu.metrics.reporters import (
+            ConsoleReporter,
+            ReporterThread,
+        )
+
+        sinks = cfg.build_reporters()
+        if not sinks:
+            sinks = [ConsoleReporter()]
+        return ReporterThread(self.metric_registry, sinks, interval)

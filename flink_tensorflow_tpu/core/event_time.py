@@ -41,7 +41,35 @@ def _end_stamped_collector(output, end: float) -> fn.Collector:
     return fn.Collector(lambda v, ts=None: output.emit(v, end if ts is None else ts))
 
 
-class TimestampAssignerOperator(Operator):
+class _WatermarkLagMixin:
+    """Watermark-lag gauge shared by the event-time operators.
+
+    Lag is measured IN THE EVENT-TIME DOMAIN: how far the watermark
+    trails the freshest record this operator has seen
+    (``max_event_ts - watermark``).  Unlike Flink's
+    processing-time-minus-watermark, this stays meaningful for synthetic
+    or replayed timestamps.  The value is sampled on each (finite)
+    watermark advance and held, so the inspector still reads it after
+    the closing ``Watermark(inf)``; None until both sides are known.
+    """
+
+    _max_event_ts: float = -math.inf
+    _last_lag_s: typing.Optional[float] = None
+
+    def _register_lag_gauge(self) -> None:
+        if self.ctx is not None:
+            self.ctx.metrics.gauge("watermark_lag_s", lambda: self._last_lag_s)
+
+    def _note_event_ts(self, ts: float) -> None:
+        if ts > self._max_event_ts:
+            self._max_event_ts = ts
+
+    def _note_watermark(self, watermark_ts: float) -> None:
+        if math.isfinite(watermark_ts) and math.isfinite(self._max_event_ts):
+            self._last_lag_s = max(0.0, self._max_event_ts - watermark_ts)
+
+
+class TimestampAssignerOperator(_WatermarkLagMixin, Operator):
     """Assigns event timestamps + periodic watermarks.
 
     ``out_of_orderness_s`` is the lateness bound: the watermark trails
@@ -62,16 +90,21 @@ class TimestampAssignerOperator(Operator):
         self._emitted_wm = -math.inf
         self._since_wm = 0
 
+    def open(self) -> None:
+        self._register_lag_gauge()
+
     def process_record(self, record: el.StreamRecord) -> None:
         ts = float(self.ts_fn(record.value))
         self.output.emit(record.value, ts)
         self._max_ts = max(self._max_ts, ts)
+        self._note_event_ts(ts)
         self._since_wm += 1
         if self._since_wm >= self.watermark_every:
             self._since_wm = 0
             wm = self._max_ts - self.slack
             if wm > self._emitted_wm:
                 self._emitted_wm = wm
+                self._note_watermark(wm)
                 self.output.broadcast_element(el.Watermark(wm))
 
     def process_watermark(self, watermark: el.Watermark) -> None:
@@ -89,7 +122,7 @@ class TimestampAssignerOperator(Operator):
         self._emitted_wm = state["emitted_wm"]
 
 
-class EventTimeWindowOperator(_FunctionOperator):
+class EventTimeWindowOperator(_WatermarkLagMixin, _FunctionOperator):
     """Tumbling or sliding event-time windows (keyed or global).
 
     ``slide_s=None`` (default) is tumbling; with a slide, each record
@@ -128,6 +161,7 @@ class EventTimeWindowOperator(_FunctionOperator):
 
     def open(self) -> None:
         self._collector = fn.Collector(self.output.emit)
+        self._register_lag_gauge()
         super().open()
 
     def _starts_for(self, ts: float) -> typing.Iterator[typing.Tuple[float, float]]:
@@ -155,6 +189,7 @@ class EventTimeWindowOperator(_FunctionOperator):
                 "timestamp — add .assign_timestamps(...) upstream"
             )
         ts = record.timestamp
+        self._note_event_ts(ts)
         key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
         assigned = False
         covered = False
@@ -183,6 +218,7 @@ class EventTimeWindowOperator(_FunctionOperator):
 
     def process_watermark(self, watermark: el.Watermark) -> None:
         self._watermark = max(self._watermark, watermark.timestamp)
+        self._note_watermark(self._watermark)
         due = sorted(
             (k for k, buf in self._buffers.items()
              if buf.window.end <= self._watermark and not buf.fired),
@@ -259,7 +295,7 @@ class EventTimeWindowOperator(_FunctionOperator):
         return {"watermark": _min_watermark(states), "buffers": buffers}
 
 
-class SessionWindowOperator(_FunctionOperator):
+class SessionWindowOperator(_WatermarkLagMixin, _FunctionOperator):
     """Event-time session windows with a fixed inactivity gap.
 
     A record at time t opens (or extends) a session [t, t+gap); sessions
@@ -287,6 +323,7 @@ class SessionWindowOperator(_FunctionOperator):
 
     def open(self) -> None:
         self._collector = fn.Collector(self.output.emit)
+        self._register_lag_gauge()
         super().open()
 
     def process_record(self, record: el.StreamRecord) -> None:
@@ -296,6 +333,7 @@ class SessionWindowOperator(_FunctionOperator):
                 "timestamp — add .assign_timestamps(...) upstream"
             )
         ts = record.timestamp
+        self._note_event_ts(ts)
         key = self.key_selector(record.value) if self.key_selector else self.GLOBAL_KEY
         sessions = self._sessions.setdefault(key, [])
         start, end = ts, ts + self.gap
@@ -331,6 +369,7 @@ class SessionWindowOperator(_FunctionOperator):
 
     def process_watermark(self, watermark: el.Watermark) -> None:
         self._watermark = max(self._watermark, watermark.timestamp)
+        self._note_watermark(self._watermark)
         due = []
         for key, sessions in self._sessions.items():
             for s in sessions:

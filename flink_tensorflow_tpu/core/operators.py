@@ -26,24 +26,61 @@ if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
 
 
-class Output:
-    """Downstream emitter for one subtask; routes via edge partitioners."""
+class SubtaskStats:
+    """Per-subtask accumulators behind the runtime's pull-based gauges.
 
-    def __init__(self, edges):
+    Written ONLY by the owning subtask thread (single-writer contract),
+    read by the reporter thread — plain float adds, no locks, so the
+    per-record cost stays O(1) with zero allocation."""
+
+    __slots__ = ("blocked_s", "idle_s", "busy_s")
+
+    def __init__(self) -> None:
+        #: Seconds this subtask's emits spent blocked on full downstream
+        #: queues (its backpressure time, Flink's backPressuredTime).
+        self.blocked_s = 0.0
+        #: Seconds spent waiting on the input gate with nothing to do.
+        self.idle_s = 0.0
+        #: Seconds spent inside record processing.
+        self.busy_s = 0.0
+
+
+class Output:
+    """Downstream emitter for one subtask; routes via edge partitioners.
+
+    ``meter``/``stats`` are optional instrumentation hooks (wired by the
+    executor): the meter marks one event per emitted record, and blocked
+    write time (returned by the channel layer) accumulates into
+    ``stats.blocked_s`` — both O(1) per record."""
+
+    def __init__(self, edges, meter=None, stats: typing.Optional[SubtaskStats] = None):
         # edges: list of (partitioner, [ChannelWriter per downstream subtask])
         self._edges = edges
+        self._meter = meter
+        self._stats = stats
 
     def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
         record = el.StreamRecord(value, timestamp)
+        blocked = 0.0
         for partitioner, writers in self._edges:
             for idx in partitioner.select(value, len(writers)):
-                writers[idx].write(record)
+                # Remote writers return None (their send path has its own
+                # accounting); local gates return blocked-put seconds.
+                dt = writers[idx].write(record)
+                if dt:
+                    blocked += dt
+        if self._meter is not None:
+            self._meter.mark()
+        if blocked and self._stats is not None:
+            self._stats.blocked_s += blocked
 
     def broadcast_element(self, element: el.StreamElement) -> None:
         """Barriers / watermarks / EOP go to every downstream channel."""
         for _, writers in self._edges:
             for w in writers:
-                w.write(element)
+                dt = w.write(element)
+                if dt and self._stats is not None:
+                    self._stats.blocked_s += dt
 
     @property
     def has_downstream(self) -> bool:

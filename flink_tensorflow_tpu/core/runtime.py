@@ -23,7 +23,12 @@ import typing
 from flink_tensorflow_tpu.core import elements as el
 from flink_tensorflow_tpu.core.channels import ChannelWriter, InputGate
 from flink_tensorflow_tpu.core.graph import CycleError, DataflowGraph, Transformation
-from flink_tensorflow_tpu.core.operators import Operator, Output, SourceOperator
+from flink_tensorflow_tpu.core.operators import (
+    Operator,
+    Output,
+    SourceOperator,
+    SubtaskStats,
+)
 from flink_tensorflow_tpu.core.partitioning import ForwardPartitioner
 from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
 from flink_tensorflow_tpu.core.state import KeyedStateStore
@@ -71,6 +76,12 @@ class _Subtask:
         self._notifications: "typing.List[int]" = []
         self.thread: typing.Optional[threading.Thread] = None
         self.finished = threading.Event()
+        # -- instrumentation (wired by the executor in _build) -----------
+        #: Single-writer accumulators behind this subtask's pull gauges.
+        self.stats = SubtaskStats()
+        self.records_in = None      # Meter (workers only)
+        self.latency = None         # Timer: per-record processing/emit time
+        self.alignment = None       # Timer: barrier-alignment spans
 
     @property
     def scope(self) -> str:
@@ -112,8 +123,12 @@ class _Subtask:
                     self.output.broadcast_element(el.CheckpointBarrier(cid))
                 if isinstance(value, el.SourceIdle):
                     continue  # idle heartbeat: barriers served, no record
+                t_emit = time.monotonic()
                 self.output.emit(value)
                 op.record_emitted()
+                # Per-record emit latency: dominated by blocked-put time
+                # when downstream backpressures (the source-side signal).
+                self.latency.update(time.monotonic() - t_emit)
                 # Count-based barriers: checkpoint k cuts the stream after
                 # this subtask's k*N-th record — a deterministic position,
                 # identical on every host running the same job (the
@@ -144,8 +159,14 @@ class _Subtask:
         n = self.num_input_channels
         eop = [False] * n
         barrier_seen: typing.Dict[int, typing.Set[int]] = {}
+        #: checkpoint id -> monotonic time its FIRST barrier arrived here
+        #: (alignment span = first barrier -> snapshot).
+        barrier_t0: typing.Dict[int, float] = {}
         watermarks = [float("-inf")] * n
         current_wm = float("-inf")
+        stats = self.stats
+        records_in = self.records_in
+        latency = self.latency
         try:
             op.open()
             active = n
@@ -153,9 +174,16 @@ class _Subtask:
                 deadline = op.next_deadline()
                 now = time.monotonic()
                 timeout = _IDLE_POLL_S if deadline is None else max(0.0, min(deadline - now, _IDLE_POLL_S))
+                poll_start = now
                 item = gate.poll(timeout=timeout)
                 self._deliver_notifications()
                 now = time.monotonic()
+                if item is None:
+                    # Nothing to process: the poll wait was idle time
+                    # (with data the dequeue returns ~immediately, so
+                    # only empty polls are charged — no extra clock read
+                    # either way).
+                    stats.idle_s += now - poll_start
                 if deadline is not None and now >= deadline:
                     op.fire_due(now)
                 if item is None:
@@ -163,13 +191,18 @@ class _Subtask:
                 idx, element = item
                 if isinstance(element, el.StreamRecord):
                     op.process_record_from(self.edge_of_channel[idx], element)
+                    latency.update(time.monotonic() - now)
+                    records_in.mark()
                 elif isinstance(element, el.CheckpointBarrier):
                     cid = element.checkpoint_id
                     seen = barrier_seen.setdefault(cid, set())
+                    if not seen:
+                        barrier_t0[cid] = now
                     seen.add(idx)
                     gate.block_channel(idx)
                     live = {i for i in range(n) if not eop[i]}
                     if live <= seen:
+                        self.alignment.update(now - barrier_t0.pop(cid, now))
                         self._snapshot_and_ack(cid)
                         self.output.broadcast_element(element)
                         del barrier_seen[cid]
@@ -190,6 +223,7 @@ class _Subtask:
                     for cid, seen in list(barrier_seen.items()):
                         live = {i for i in range(n) if not eop[i]}
                         if live and live <= seen:
+                            self.alignment.update(now - barrier_t0.pop(cid, now))
                             self._snapshot_and_ack(cid)
                             self.output.broadcast_element(el.CheckpointBarrier(cid))
                             del barrier_seen[cid]
@@ -369,11 +403,44 @@ class LocalExecutor:
                     import copy
 
                     edges_for_output.append((copy.deepcopy(edge.partitioner), writers))
-                st.output = Output(edges_for_output)
+                grp = self.metrics.group(st.scope)
+                st.output = Output(edges_for_output,
+                                   meter=grp.meter("records_out"),
+                                   stats=st.stats)
+                st.records_in = grp.meter("records_in")
+                st.latency = grp.timer("process_latency_s")
+                st.alignment = grp.timer("checkpoint_alignment_s")
+                # Pull-based gauges: the hot path only bumps the plain
+                # accumulators above; evaluation happens at report time.
+                stats = st.stats
+                latency = st.latency
+                grp.gauge("idle_s", lambda s=stats: s.idle_s)
+                grp.gauge("busy_s", lambda tm=latency: tm.total_s)
+                grp.gauge("backpressure_s", lambda s=stats: s.blocked_s)
+                gate_for_metrics = st.gate
+                if gate_for_metrics is not None:
+                    grp.gauge("queue_depth",
+                              lambda g=gate_for_metrics: g.depth)
+                    grp.gauge("queue_high_watermark",
+                              lambda g=gate_for_metrics: g.high_watermark)
+                    # Time UPSTREAM writers spent blocked putting into
+                    # this subtask's gate — "this operator causes the
+                    # backpressure above it".
+                    grp.gauge("in_backpressure_s",
+                              lambda g=gate_for_metrics: g.blocked_put_s)
                 state = KeyedStateStore()
                 device = (
                     self.device_provider(t.name, st.index) if self.device_provider else None
                 )
+                if device is not None:
+                    from flink_tensorflow_tpu.utils.profiling import (
+                        device_memory_stats,
+                    )
+
+                    grp.gauge(
+                        "hbm_bytes_in_use",
+                        lambda d=device: device_memory_stats(d).get("bytes_in_use"),
+                    )
                 proc_idx, num_procs = self._process_identity()
                 ctx = RuntimeContext(
                     task_name=t.name,

@@ -1,9 +1,46 @@
+"""Job observability plane: metric types, pluggable reporters, inspector.
+
+- :mod:`.registry` — Counter/Meter/Gauge/Timer/Histogram + the per-job
+  :class:`MetricRegistry` (scope-tree snapshots, seeded reservoirs).
+- :mod:`.reporters` — :class:`MetricReporter` sinks (JSON-lines,
+  Prometheus text exposition, console) driven by a daemon
+  :class:`ReporterThread`; configured via :class:`MetricConfig`.
+- :mod:`.inspector` — ``python -m flink_tensorflow_tpu.metrics
+  <pipeline.py>`` / ``flink-tpu-inspect``: execute a pipeline under the
+  metric plane and print per-operator rate, latency percentiles, queue
+  depth, backpressure, and watermark lag.
+"""
+
 from flink_tensorflow_tpu.metrics.registry import (
     Counter,
+    Gauge,
     Histogram,
     Meter,
     MetricGroup,
     MetricRegistry,
+    Timer,
+)
+from flink_tensorflow_tpu.metrics.reporters import (
+    ConsoleReporter,
+    JsonLinesReporter,
+    MetricConfig,
+    MetricReporter,
+    PrometheusFileReporter,
+    ReporterThread,
 )
 
-__all__ = ["Counter", "Histogram", "Meter", "MetricGroup", "MetricRegistry"]
+__all__ = [
+    "ConsoleReporter",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonLinesReporter",
+    "Meter",
+    "MetricConfig",
+    "MetricGroup",
+    "MetricRegistry",
+    "MetricReporter",
+    "PrometheusFileReporter",
+    "ReporterThread",
+    "Timer",
+]

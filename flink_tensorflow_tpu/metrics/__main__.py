@@ -1,0 +1,8 @@
+"""``python -m flink_tensorflow_tpu.metrics <pipeline.py>`` — job inspector."""
+
+import sys
+
+from flink_tensorflow_tpu.metrics.inspector import main
+
+if __name__ == "__main__":
+    sys.exit(main())

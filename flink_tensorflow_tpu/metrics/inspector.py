@@ -1,0 +1,207 @@
+"""Live job inspector — run a pipeline, print per-operator runtime stats.
+
+    python -m flink_tensorflow_tpu.metrics examples/mnist_lenet.py
+    flink-tpu-inspect examples/mnist_lenet.py --snapshot-only
+
+The inspector captures a pipeline script's plan the same way the
+plan-time analyzer does (``analysis.capture``: the script's ``main`` runs
+with ``execute`` patched out, so we get the fully-configured
+environment), then ACTUALLY executes the job with the metric plane
+attached and prints:
+
+- a per-operator-subtask table: records/sec, p50/p99 record latency,
+  queue depth, backpressure fraction, watermark lag;
+- one machine-readable JSON snapshot line (``--snapshot-only`` emits only
+  this) — the shape benches and CI assertions parse.
+
+Exit code 0 = ran to completion; 2 = capture or execution failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+import time
+import typing
+
+Row = typing.Dict[str, typing.Any]
+
+#: Scopes that are job-level, not operator subtasks.
+_JOB_SCOPES = {"checkpoint"}
+
+
+def _split_scope(scope: str) -> typing.Tuple[str, typing.Optional[int]]:
+    """``"lenet.0" -> ("lenet", 0)``; non-subtask scopes keep index None."""
+    task, dot, tail = scope.rpartition(".")
+    if dot and tail.isdigit():
+        return task, int(tail)
+    return scope, None
+
+
+def _finite(value: typing.Any) -> typing.Optional[float]:
+    if isinstance(value, (int, float)) and not isinstance(value, bool) \
+            and math.isfinite(value):
+        return float(value)
+    return None
+
+
+def build_rows(snapshot: typing.Dict[str, typing.Dict[str, typing.Any]],
+               wall_s: float) -> typing.List[Row]:
+    """Fold a ``MetricRegistry.snapshot()`` scope tree into one row per
+    operator subtask with the inspector's canonical fields.  Every row
+    carries every key (None where the runtime had nothing to measure —
+    e.g. watermark lag on a processing-time pipeline)."""
+    rows: typing.List[Row] = []
+    for scope in sorted(snapshot):
+        task, index = _split_scope(scope)
+        if index is None or task in _JOB_SCOPES:
+            continue
+        m = snapshot[scope]
+        records_in = (m.get("records_in") or {}).get("count", 0)
+        records_out = (m.get("records_out") or {}).get("count", 0)
+        processed = records_in or records_out
+        # Per-record latency: the model runner's device-inclusive number
+        # when present, else the operator's host processing latency.
+        lat = m.get("record_latency_s") or m.get("process_latency_s") or {}
+        busy = _finite((m.get("process_latency_s") or {}).get("total_s"))
+        blocked = _finite(m.get("backpressure_s")) or 0.0
+        rows.append({
+            "operator": task,
+            "subtask": index,
+            "records_in": records_in,
+            "records_out": records_out,
+            "records_per_s": (processed / wall_s) if wall_s > 0 else None,
+            "p50_latency_s": _finite(lat.get("p50")),
+            "p99_latency_s": _finite(lat.get("p99")),
+            # Sources have no input gate: their queue depth is genuinely 0.
+            "queue_depth": m.get("queue_depth") or 0,
+            "queue_high_watermark": m.get("queue_high_watermark") or 0,
+            "backpressure_s": blocked,
+            "backpressure_fraction":
+                min(1.0, blocked / wall_s) if wall_s > 0 else None,
+            "busy_fraction":
+                min(1.0, busy / wall_s) if busy is not None and wall_s > 0 else None,
+            "watermark_lag_s": _finite(m.get("watermark_lag_s")),
+        })
+    return rows
+
+
+def _fmt(value: typing.Any, scale: float = 1.0, digits: int = 2) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value * scale:.{digits}f}"
+    return str(value)
+
+
+def format_table(rows: typing.Sequence[Row]) -> str:
+    header = ["operator", "rec/s", "p50 ms", "p99 ms", "queue",
+              "bp %", "busy %", "wm lag s"]
+    body = [[
+        f"{r['operator']}.{r['subtask']}",
+        _fmt(r["records_per_s"], digits=1),
+        _fmt(r["p50_latency_s"], 1e3),
+        _fmt(r["p99_latency_s"], 1e3),
+        _fmt(r["queue_depth"]),
+        _fmt(r["backpressure_fraction"], 100, 1),
+        _fmt(r["busy_fraction"], 100, 1),
+        _fmt(r["watermark_lag_s"], digits=3),
+    ] for r in rows]
+    widths = [max(len(h), *(len(b[i]) for b in body)) if body else len(h)
+              for i, h in enumerate(header)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    lines.append("  ".join("-" * w for w in widths))
+    for b in body:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(b, widths)))
+    return "\n".join(lines)
+
+
+def inspect_pipeline(
+    path: str,
+    job_args: typing.Sequence[str] = ("--smoke", "--cpu"),
+    *,
+    report_interval_s: typing.Optional[float] = None,
+    jsonl_path: typing.Optional[str] = None,
+    prometheus_path: typing.Optional[str] = None,
+    timeout_s: float = 600.0,
+) -> typing.Dict[str, typing.Any]:
+    """Capture ``path``'s plan, execute it with the metric plane attached,
+    and return the job snapshot (the JSON the CLI prints)."""
+    from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
+
+    env = capture_pipeline_file(path, job_args)
+    metrics_cfg = dataclasses.replace(
+        env.config.metrics,
+        report_interval_s=report_interval_s,
+        jsonl_path=jsonl_path,
+        prometheus_path=prometheus_path,
+    )
+    env.configure(metrics=metrics_cfg)
+    t0 = time.monotonic()
+    env.execute("inspect", timeout=timeout_s)
+    wall_s = time.monotonic() - t0
+    tree = env.metric_registry.snapshot()
+    job_level = {scope: tree[scope] for scope in _JOB_SCOPES if scope in tree}
+    return {
+        "pipeline": path,
+        "wall_s": wall_s,
+        "subtasks": build_rows(tree, wall_s),
+        "job": job_level,
+    }
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flink_tensorflow_tpu.metrics",
+        description="Job inspector: execute a pipeline script with runtime "
+                    "instrumentation attached and print per-operator rate, "
+                    "latency percentiles, queue depth, backpressure, and "
+                    "watermark lag.",
+    )
+    parser.add_argument("pipelines", nargs="+", metavar="pipeline.py",
+                        help="pipeline script(s) defining main(argv)")
+    parser.add_argument("--job-args", default="--smoke --cpu",
+                        help="argv passed to each pipeline's main() "
+                             "(default: '--smoke --cpu')")
+    parser.add_argument("--interval", type=float, default=None,
+                        help="live report interval in seconds (default: no "
+                             "reporter thread; one snapshot at completion)")
+    parser.add_argument("--jsonl", default=None, metavar="PATH",
+                        help="also append JSON-lines reports to PATH")
+    parser.add_argument("--prometheus", default=None, metavar="PATH",
+                        help="also maintain a Prometheus exposition file")
+    parser.add_argument("--timeout", type=float, default=600.0,
+                        help="job execution timeout in seconds")
+    parser.add_argument("--snapshot-only", action="store_true",
+                        help="emit only the machine-readable JSON snapshot")
+    args = parser.parse_args(argv)
+
+    exit_code = 0
+    for path in args.pipelines:
+        try:
+            snap = inspect_pipeline(
+                path, args.job_args.split(),
+                report_interval_s=args.interval,
+                jsonl_path=args.jsonl,
+                prometheus_path=args.prometheus,
+                timeout_s=args.timeout,
+            )
+        except Exception as ex:  # noqa: BLE001 - report and keep going
+            print(f"{path}: inspection failed: {ex}", file=sys.stderr)
+            exit_code = max(exit_code, 2)
+            continue
+        if not args.snapshot_only:
+            print(f"== {path} ({snap['wall_s']:.2f}s wall) ==")
+            print(format_table(snap["subtasks"]))
+        from flink_tensorflow_tpu.metrics.reporters import json_safe
+
+        print(json.dumps(json_safe(snap)))
+    return exit_code
+
+
+def cli() -> None:
+    """Console-script entry point (``flink-tpu-inspect``)."""
+    sys.exit(main())

@@ -1,10 +1,18 @@
-"""Metrics — counters, meters, latency histograms per operator subtask.
+"""Metrics — counters, meters, gauges, timers, latency histograms per
+operator subtask.
 
 The reference exposes Flink metric groups (counters/meters per operator,
 SURVEY.md §5 "Metrics").  Here records/sec/chip and p50/p99 per-record
 latency are first-class because they ARE the north-star metric
 (BASELINE.json:2).  Histograms keep a bounded reservoir so the hot path
 stays O(1) with no allocation beyond a float append.
+
+Hot-path contract: push-side operations (``Counter.inc``, ``Meter.mark``,
+``Histogram.record``, ``Timer.update``) are O(1) per record.  Everything
+pull-based — :class:`Gauge` callbacks, rates, percentiles — is evaluated
+only when a reporter (metrics.reporters) or the inspector CLI reads a
+:meth:`MetricRegistry.snapshot`, so instrumentation that is never read
+costs nothing beyond the increments.
 """
 
 from __future__ import annotations
@@ -12,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 import typing
+import zlib
 
 import numpy as np
 
@@ -27,42 +36,66 @@ class Counter:
 
 
 class Meter:
-    """Rate meter: events/sec over the job's lifetime and a sliding window."""
+    """Rate meter: events/sec over the job's lifetime and a sliding window.
 
-    __slots__ = ("count", "_start", "_win_count", "_win_start")
+    Thread-safe: one meter may be marked from several threads (an
+    operator's background fetch thread and its subtask thread) while a
+    reporter reads it.  ``window_rate()`` is PURE — it never consumes the
+    window, so a reporter and user code can both read it; the owner of
+    the window cadence calls :meth:`reset_window` explicitly.
+    """
+
+    __slots__ = ("count", "_start", "_win_count", "_win_start", "_lock")
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.count = 0
         self._start = time.monotonic()
         self._win_count = 0
         self._win_start = self._start
 
     def mark(self, n: int = 1) -> None:
-        self.count += n
-        self._win_count += n
+        with self._lock:
+            self.count += n
+            self._win_count += n
 
     def rate(self) -> float:
         elapsed = time.monotonic() - self._start
         return self.count / elapsed if elapsed > 0 else 0.0
 
     def window_rate(self) -> float:
-        now = time.monotonic()
-        elapsed = now - self._win_start
-        rate = self._win_count / elapsed if elapsed > 0 else 0.0
-        self._win_count = 0
-        self._win_start = now
-        return rate
+        """Events/sec since the last :meth:`reset_window` — read-only."""
+        with self._lock:
+            count, start = self._win_count, self._win_start
+        elapsed = time.monotonic() - start
+        return count / elapsed if elapsed > 0 else 0.0
+
+    def reset_window(self) -> None:
+        """Start a fresh rate window (the reporter thread owns the
+        cadence; user code reading ``window_rate()`` must not steal it)."""
+        with self._lock:
+            self._win_count = 0
+            self._win_start = time.monotonic()
 
 
 class Histogram:
-    """Bounded-reservoir histogram for latency percentiles."""
+    """Bounded-reservoir histogram for latency percentiles.
 
-    __slots__ = ("_samples", "_capacity", "count")
+    The reservoir uses a PER-INSTANCE ``np.random.Generator`` (seeded
+    deterministically from the registry's configured seed + the metric's
+    scope/name): sampling through the global ``np.random`` state would
+    both break the repo's determinism guarantees (user jobs seed the
+    global state) and race when other threads draw from it.
+    """
 
-    def __init__(self, capacity: int = 65536):
+    __slots__ = ("_samples", "_capacity", "count", "_rng")
+
+    def __init__(self, capacity: int = 65536,
+                 seed: typing.Optional[int] = None):
         self._samples: typing.List[float] = []
         self._capacity = capacity
         self.count = 0
+        self._rng = np.random.default_rng(seed)
 
     def record(self, value: float) -> None:
         self.count += 1
@@ -70,7 +103,7 @@ class Histogram:
             self._samples.append(value)
         else:
             # Reservoir sampling keeps percentiles unbiased under overflow.
-            j = np.random.randint(0, self.count)
+            j = int(self._rng.integers(0, self.count))
             if j < self._capacity:
                 self._samples[j] = value
 
@@ -89,6 +122,72 @@ class Histogram:
         }
 
 
+class Gauge:
+    """Pull-based metric: a zero-arg callback evaluated at REPORT time.
+
+    The hot path never touches a gauge — instrumented code exposes live
+    state (queue depth, accumulated blocked time, HBM bytes) and the
+    reporter thread reads it at its own cadence.  A raising callback
+    yields None (a dying metric must never fail a report)."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn: typing.Callable[[], typing.Any]):
+        self._fn = fn
+
+    def set_callback(self, fn: typing.Callable[[], typing.Any]) -> None:
+        self._fn = fn
+
+    def value(self) -> typing.Any:
+        try:
+            return self._fn()
+        except Exception:  # noqa: BLE001 - reporting must not kill the job
+            return None
+
+
+class Timer:
+    """Duration tracker: a histogram of seconds + total time + count.
+
+    Use as a context manager (``with timer.time(): ...``) or feed
+    measured intervals via :meth:`update` when the caller already has
+    the two clock reads (the runtime loop does — no extra ``monotonic()``
+    calls on the hot path)."""
+
+    __slots__ = ("histogram", "count", "total_s")
+
+    def __init__(self, seed: typing.Optional[int] = None):
+        self.histogram = Histogram(seed=seed)
+        self.count = 0
+        self.total_s = 0.0
+
+    def update(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.histogram.record(seconds)
+
+    class _Span:
+        __slots__ = ("_timer", "_t0")
+
+        def __init__(self, timer: "Timer"):
+            self._timer = timer
+            self._t0 = 0.0
+
+        def __enter__(self) -> "Timer._Span":
+            self._t0 = time.monotonic()
+            return self
+
+        def __exit__(self, *exc) -> None:
+            self._timer.update(time.monotonic() - self._t0)
+
+    def time(self) -> "Timer._Span":
+        return Timer._Span(self)
+
+    def summary(self) -> typing.Dict[str, float]:
+        out = self.histogram.summary()
+        out["total_s"] = self.total_s
+        return out
+
+
 class MetricGroup:
     """Namespaced metric container for one operator subtask."""
 
@@ -103,13 +202,47 @@ class MetricGroup:
         return self._registry._get(self.scope, name, Meter)
 
     def histogram(self, name: str) -> Histogram:
-        return self._registry._get(self.scope, name, Histogram)
+        seed = self._registry.metric_seed(self.scope, name)
+        return self._registry._get(
+            self.scope, name, lambda: Histogram(seed=seed))
+
+    def timer(self, name: str) -> Timer:
+        seed = self._registry.metric_seed(self.scope, name)
+        return self._registry._get(self.scope, name, lambda: Timer(seed=seed))
+
+    def gauge(self, name: str,
+              fn: typing.Optional[typing.Callable[[], typing.Any]] = None) -> Gauge:
+        """Register (or re-point) a pull-based gauge.  With ``fn`` the
+        callback is installed — re-registration replaces it (a restarted
+        operator re-binds its gauges to fresh state); without ``fn`` the
+        existing gauge is returned for reading."""
+        gauge = self._registry._get(
+            self.scope, name, lambda: Gauge(fn if fn is not None else lambda: None))
+        if fn is not None:
+            gauge.set_callback(fn)
+        return gauge
 
 
 class MetricRegistry:
-    def __init__(self) -> None:
+    """All metrics of one job, keyed by (scope, name).
+
+    ``seed`` makes every histogram reservoir deterministic: each metric
+    derives its own generator seed from (seed, scope, name), so two runs
+    of the same seeded job sample identically regardless of thread
+    interleaving elsewhere.  ``seed=None`` keeps instance-local
+    OS-entropy generators (still race-free, just not reproducible).
+    """
+
+    def __init__(self, seed: typing.Optional[int] = None) -> None:
+        self.seed = seed
         self._metrics: typing.Dict[typing.Tuple[str, str], typing.Any] = {}
         self._lock = threading.Lock()
+
+    def metric_seed(self, scope: str, name: str) -> typing.Optional[int]:
+        """Stable per-metric seed derived from the registry seed."""
+        if self.seed is None:
+            return None
+        return zlib.crc32(f"{self.seed}/{scope}/{name}".encode())
 
     def _get(self, scope: str, name: str, factory: typing.Callable[[], typing.Any]):
         key = (scope, name)
@@ -127,14 +260,40 @@ class MetricRegistry:
         with self._lock:
             return dict(self._metrics)
 
+    @staticmethod
+    def _read(metric: typing.Any) -> typing.Any:
+        if isinstance(metric, Counter):
+            return metric.value
+        if isinstance(metric, Meter):
+            return {"count": metric.count, "rate": metric.rate(),
+                    "window_rate": metric.window_rate()}
+        if isinstance(metric, Timer):
+            return metric.summary()
+        if isinstance(metric, Histogram):
+            return metric.summary()
+        if isinstance(metric, Gauge):
+            return metric.value()
+        return metric
+
     def report(self) -> typing.Dict[str, typing.Any]:
+        """Flat ``{scope.name: value}`` view (the legacy JobResult shape)."""
         out: typing.Dict[str, typing.Any] = {}
         for (scope, name), metric in self.all_metrics().items():
-            key = f"{scope}.{name}"
-            if isinstance(metric, Counter):
-                out[key] = metric.value
-            elif isinstance(metric, Meter):
-                out[key] = {"count": metric.count, "rate": metric.rate()}
-            elif isinstance(metric, Histogram):
-                out[key] = metric.summary()
+            out[f"{scope}.{name}"] = self._read(metric)
         return out
+
+    def snapshot(self) -> typing.Dict[str, typing.Dict[str, typing.Any]]:
+        """Scope-tree view ``{scope: {metric: value}}`` — what reporters
+        and the inspector CLI consume.  Gauges are evaluated here (pull),
+        meters are read without consuming their window."""
+        tree: typing.Dict[str, typing.Dict[str, typing.Any]] = {}
+        for (scope, name), metric in self.all_metrics().items():
+            tree.setdefault(scope, {})[name] = self._read(metric)
+        return tree
+
+    def reset_windows(self) -> None:
+        """Start a fresh window on every meter — the reporter thread calls
+        this once per report so window rates mean "since last report"."""
+        for metric in self.all_metrics().values():
+            if isinstance(metric, Meter):
+                metric.reset_window()

@@ -12,6 +12,12 @@ number (BASELINE.json:2):
   timelines attribute device work to operator + batch number.
 - per-operator latency histograms/meters live in metrics.registry and
   are always on (p50/p99 per record — the north-star denominators).
+- continuous publication of those metrics (JSON-lines / Prometheus /
+  console sinks on a reporter interval) lives in
+  :mod:`flink_tensorflow_tpu.metrics.reporters`; the per-job inspector
+  CLI is ``python -m flink_tensorflow_tpu.metrics <pipeline.py>``
+  (:mod:`flink_tensorflow_tpu.metrics.inspector`).  The runtime's HBM
+  gauges pull :func:`device_memory_stats` through that plane.
 """
 
 from __future__ import annotations

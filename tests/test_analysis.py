@@ -433,3 +433,90 @@ class TestCLIAcceptance:
             cwd=REPO, capture_output=True, text=True, timeout=120,
         )
         assert proc.returncode == 2
+
+
+class _StubAsyncMap(fn.AsyncMapFunction):
+    """Async map with a declared transparent micro-batch (the attribute
+    ModelMapFunction carries) for the watermark-flush lint."""
+
+    _micro_batch = 8
+
+    def map_async(self, value, out):
+        out.collect(value)
+
+    def flush(self, out=None):
+        pass
+
+
+class _CountWindowFn(fn.WindowFunction):
+    def process_window(self, key, window, elements, out):
+        out.collect(len(elements))
+
+
+class TestWatermarkLints:
+    """ISSUE-2 satellite: the deferred watermark lints from ROADMAP."""
+
+    def test_event_time_window_without_assigner_is_error(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([("k", 1.0)])
+            .key_by(lambda e: e[0])
+            .time_window(1.0)
+            .apply(_CountWindowFn())
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph), "watermark-missing-assigner")
+        assert len(diags) == 1
+        assert diags[0].severity == Severity.ERROR
+        assert diags[0].node == "time_window"
+
+    def test_session_window_without_assigner_is_error(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([("k", 1.0)])
+            .key_by(lambda e: e[0])
+            .session_window(1.0)
+            .apply(_CountWindowFn())
+            .sink_to_list())
+        assert len(by_rule(analyze(env.graph),
+                           "watermark-missing-assigner")) == 1
+
+    def test_assigner_anywhere_upstream_is_clean(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([("k", 1.0)])
+            .assign_timestamps(lambda e: e[1])
+            .map(_IdMap(), name="hop")           # assigner not adjacent
+            .key_by(lambda e: e[0])
+            .time_window(1.0)
+            .apply(_CountWindowFn())
+            .sink_to_list())
+        assert by_rule(analyze(env.graph), "watermark-missing-assigner") == []
+
+    def test_fine_watermarks_feeding_async_map_warn(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1.0, 2.0])
+            .assign_timestamps(lambda e: e, watermark_every=1)
+            .map(_StubAsyncMap(), name="asyncmap")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph), "watermark-async-flush")
+        assert len(diags) == 1
+        assert diags[0].severity == Severity.WARN
+        assert diags[0].node == "asyncmap"
+        assert "watermark_every >= 8" in diags[0].message
+
+    def test_coarse_watermarks_are_clean(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1.0, 2.0])
+            .assign_timestamps(lambda e: e, watermark_every=8)
+            .map(_StubAsyncMap(), name="asyncmap")
+            .sink_to_list())
+        assert by_rule(analyze(env.graph), "watermark-async-flush") == []
+
+    def test_second_assigner_retimes_the_stream(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1.0, 2.0])
+            .assign_timestamps(lambda e: e, watermark_every=1, name="fine")
+            .assign_timestamps(lambda e: e, watermark_every=8, name="coarse")
+            .map(_StubAsyncMap(), name="asyncmap")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph), "watermark-async-flush")
+        # Only the assigner actually feeding the map counts; the fine one
+        # is shadowed by the coarse re-timing below it.
+        assert diags == []

@@ -1,7 +1,7 @@
 """Tier-1 lint guard: ruff over the repo, the plan analyzer over every
 example pipeline.
 
-Two layers of "clean":
+Three layers of "clean":
 
 1. ``ruff check`` (config in pyproject.toml — pycodestyle/pyflakes/isort
    rules) over the package, examples, and tests.  Skipped when ruff is
@@ -11,8 +11,13 @@ Two layers of "clean":
    execute-capture: zero ERROR diagnostics, ever.  This is the guard
    that keeps the examples' schema annotations and the analyzer's rules
    honest against each other.
+3. (slow) The job inspector in ``--snapshot-only`` mode over the same
+   examples: each must EXECUTE to completion under the metric plane and
+   emit a parseable snapshot with the canonical per-subtask fields —
+   the runtime-instrumentation honesty guard.
 """
 
+import json
 import pathlib
 import shutil
 import subprocess
@@ -55,3 +60,24 @@ def test_examples_plan_has_no_error_diagnostics(pipeline):
     diags = analyze(env.graph, config=env.config)
     errors = [d for d in diags if d.severity == Severity.ERROR]
     assert errors == [], format_diagnostics(diags)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("pipeline", EXAMPLES)
+def test_examples_inspect_clean(pipeline):
+    """Every example is self-benchmarking: the inspector executes it in
+    smoke mode and the snapshot carries the canonical fields for every
+    operator subtask.  Slow (runs the jobs, XLA compiles included)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "flink_tensorflow_tpu.metrics",
+         pipeline, "--snapshot-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    snap = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert snap["subtasks"], "no operator subtasks in the snapshot"
+    for row in snap["subtasks"]:
+        for key in ("records_per_s", "p50_latency_s", "p99_latency_s",
+                    "queue_depth", "backpressure_fraction",
+                    "watermark_lag_s"):
+            assert key in row, f"{row['operator']}.{row['subtask']}: {key}"

@@ -1,0 +1,535 @@
+"""Observability plane: metric types, reporters, runtime instrumentation,
+inspector CLI.
+
+All tier-1 fast — no TPU, tiny streams, no reporter intervals longer
+than a fraction of a second.
+"""
+
+import io
+import json
+import math
+import pathlib
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+from flink_tensorflow_tpu.metrics import (
+    ConsoleReporter,
+    Gauge,
+    Histogram,
+    JsonLinesReporter,
+    Meter,
+    MetricConfig,
+    MetricRegistry,
+    MetricReporter,
+    PrometheusFileReporter,
+    ReporterThread,
+    Timer,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# metric types
+# ---------------------------------------------------------------------------
+
+
+class TestGauge:
+    def test_callback_evaluated_at_read_time(self):
+        box = {"v": 1}
+        g = Gauge(lambda: box["v"])
+        assert g.value() == 1
+        box["v"] = 7
+        assert g.value() == 7
+
+    def test_raising_callback_yields_none(self):
+        g = Gauge(lambda: 1 / 0)
+        assert g.value() is None
+
+    def test_reregistration_replaces_callback(self):
+        registry = MetricRegistry()
+        grp = registry.group("op.0")
+        grp.gauge("depth", lambda: 1)
+        grp.gauge("depth", lambda: 2)  # operator restart re-binds
+        assert registry.snapshot()["op.0"]["depth"] == 2
+
+    def test_registry_snapshot_pulls_gauges(self):
+        registry = MetricRegistry()
+        state = {"n": 0}
+        registry.group("a.0").gauge("n", lambda: state["n"])
+        state["n"] = 42
+        assert registry.snapshot()["a.0"]["n"] == 42
+
+
+class TestTimer:
+    def test_update_accumulates(self):
+        t = Timer()
+        t.update(0.5)
+        t.update(1.5)
+        assert t.count == 2
+        assert t.total_s == pytest.approx(2.0)
+        assert t.histogram.count == 2
+
+    def test_context_manager_records_elapsed(self):
+        t = Timer()
+        with t.time():
+            time.sleep(0.01)
+        assert t.count == 1
+        assert 0.005 < t.total_s < 1.0
+
+    def test_summary_includes_total(self):
+        t = Timer()
+        t.update(1.0)
+        s = t.summary()
+        assert s["total_s"] == pytest.approx(1.0)
+        assert s["p50"] == pytest.approx(1.0)
+
+
+class TestMeter:
+    def test_window_rate_is_pure(self):
+        m = Meter()
+        m.mark(100)
+        r1 = m.window_rate()
+        r2 = m.window_rate()
+        # Reading must not consume the window (both see the same count;
+        # rates differ only by the tiny elapsed-time delta).
+        assert r1 > 0 and r2 > 0
+        assert m.count == 100
+
+    def test_reset_window_starts_fresh(self):
+        m = Meter()
+        m.mark(100)
+        m.reset_window()
+        assert m.window_rate() == 0.0
+        m.mark(5)
+        assert m.window_rate() > 0.0
+        assert m.count == 105  # lifetime count untouched
+
+    def test_thread_safety_smoke(self):
+        m = Meter()
+        n_threads, per_thread = 8, 5000
+
+        def pound():
+            for _ in range(per_thread):
+                m.mark()
+
+        threads = [threading.Thread(target=pound) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert m.count == n_threads * per_thread
+
+
+class TestHistogramReservoir:
+    def test_deterministic_under_seed(self):
+        a = Histogram(capacity=32, seed=7)
+        b = Histogram(capacity=32, seed=7)
+        values = list(np.random.RandomState(0).rand(2000))
+        for v in values:
+            a.record(v)
+            b.record(v)
+        assert a._samples == b._samples  # identical reservoir decisions
+
+    def test_does_not_touch_global_numpy_state(self):
+        np.random.seed(1234)
+        before = np.random.get_state()[1].copy()
+        h = Histogram(capacity=8, seed=3)
+        for v in range(1000):
+            h.record(float(v))
+        after = np.random.get_state()[1]
+        assert np.array_equal(before, after)
+
+    def test_registry_seed_derives_per_metric_seeds(self):
+        r1 = MetricRegistry(seed=99)
+        r2 = MetricRegistry(seed=99)
+        assert r1.metric_seed("op.0", "lat") == r2.metric_seed("op.0", "lat")
+        assert r1.metric_seed("op.0", "lat") != r1.metric_seed("op.1", "lat")
+        assert MetricRegistry(seed=100).metric_seed("op.0", "lat") != \
+            r1.metric_seed("op.0", "lat")
+
+    def test_seeded_registries_sample_identically(self):
+        def run(seed):
+            reg = MetricRegistry(seed=seed)
+            h = reg.group("op.0").histogram("lat")
+            h._capacity = 16  # force overflow fast
+            for v in range(500):
+                h.record(float(v))
+            return list(h._samples)
+
+        assert run(5) == run(5)
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+
+def _populated_registry() -> MetricRegistry:
+    reg = MetricRegistry(seed=1)
+    grp = reg.group("op.0")
+    grp.counter("events").inc(3)
+    grp.meter("records").mark(10)
+    grp.histogram("latency_s").record(0.25)
+    grp.gauge("depth", lambda: 4)
+    grp.timer("span_s").update(0.5)
+    return reg
+
+
+class TestJsonLinesReporter:
+    def test_round_trip(self, tmp_path):
+        reg = _populated_registry()
+        path = tmp_path / "metrics.jsonl"
+        rep = JsonLinesReporter(str(path))
+        rep.report(reg.snapshot(), timestamp=123.0)
+        rep.report(reg.snapshot(), timestamp=124.0)
+        rep.close()
+        lines = [json.loads(x) for x in path.read_text().splitlines()]
+        assert len(lines) == 2
+        m = lines[0]["metrics"]["op.0"]
+        assert m["events"] == 3
+        assert m["records"]["count"] == 10
+        assert m["latency_s"]["p50"] == pytest.approx(0.25)
+        assert m["depth"] == 4
+        assert m["span_s"]["total_s"] == pytest.approx(0.5)
+
+    def test_nan_becomes_null(self, tmp_path):
+        reg = MetricRegistry()
+        reg.group("a.0").histogram("h")  # empty -> NaN percentiles
+        path = tmp_path / "m.jsonl"
+        rep = JsonLinesReporter(str(path))
+        rep.report(reg.snapshot(), timestamp=0.0)
+        rep.close()
+        parsed = json.loads(path.read_text())  # must be strict-JSON parseable
+        assert parsed["metrics"]["a.0"]["h"]["p50"] is None
+
+
+class TestPrometheusFileReporter:
+    def test_exposition_format_and_atomicity(self, tmp_path):
+        reg = _populated_registry()
+        path = tmp_path / "metrics.prom"
+        rep = PrometheusFileReporter(str(path))
+        rep.report(reg.snapshot(), timestamp=1.0)
+        text = path.read_text()
+        assert 'flink_tpu_events{scope="op.0"} 3' in text
+        assert 'flink_tpu_records_count{scope="op.0"} 10' in text
+        assert 'flink_tpu_depth{scope="op.0"} 4' in text
+        assert "# TYPE flink_tpu_events gauge" in text
+        assert not (tmp_path / "metrics.prom.tmp").exists()
+        # Second report REPLACES (atomic rewrite, not append).
+        rep.report(reg.snapshot(), timestamp=2.0)
+        assert path.read_text().count('flink_tpu_events{scope="op.0"}') == 1
+
+    def test_skips_non_finite(self, tmp_path):
+        reg = MetricRegistry()
+        reg.group("a.0").histogram("h")  # NaN percentiles
+        path = tmp_path / "m.prom"
+        PrometheusFileReporter(str(path)).report(reg.snapshot(), timestamp=0.0)
+        assert "nan" not in path.read_text().lower()
+
+
+class TestConsoleReporter:
+    def test_writes_scope_lines(self):
+        reg = _populated_registry()
+        buf = io.StringIO()
+        ConsoleReporter(stream=buf).report(reg.snapshot(), timestamp=time.time())
+        out = buf.getvalue()
+        assert "op.0" in out
+        assert "events=3" in out
+
+
+class _RecordingReporter(MetricReporter):
+    def __init__(self):
+        self.reports = []
+        self.closed = False
+
+    def report(self, snapshot, *, timestamp):
+        self.reports.append(snapshot)
+
+    def close(self):
+        self.closed = True
+
+
+class TestReporterThread:
+    def test_periodic_reports_then_final_on_stop(self):
+        reg = _populated_registry()
+        sink = _RecordingReporter()
+        thread = ReporterThread(reg, [sink], interval_s=0.02)
+        thread.start()
+        time.sleep(0.15)
+        thread.stop()
+        assert len(sink.reports) >= 2  # periodic + the final stop() report
+        assert sink.closed
+        assert sink.reports[-1]["op.0"]["events"] == 3
+
+    def test_stop_idempotent(self):
+        thread = ReporterThread(MetricRegistry(), [], interval_s=1.0)
+        thread.start()
+        thread.stop()
+        thread.stop()
+
+    def test_failing_sink_does_not_stop_others(self):
+        class Bomb(MetricReporter):
+            def report(self, snapshot, *, timestamp):
+                raise RuntimeError("boom")
+
+        reg = _populated_registry()
+        sink = _RecordingReporter()
+        thread = ReporterThread(reg, [Bomb(), sink], interval_s=0.02)
+        thread.start()
+        time.sleep(0.06)
+        thread.stop()
+        assert sink.reports
+
+    def test_window_reset_per_report(self):
+        reg = MetricRegistry()
+        meter = reg.group("a.0").meter("m")
+        meter.mark(50)
+        thread = ReporterThread(reg, [_RecordingReporter()], interval_s=0.02)
+        thread.start()
+        time.sleep(0.08)
+        thread.stop()
+        # The reporter owns the window cadence: after its reports the
+        # window no longer carries the initial burst.
+        assert meter.window_rate() < meter.rate()
+
+
+# ---------------------------------------------------------------------------
+# runtime integration
+# ---------------------------------------------------------------------------
+
+
+class TestRuntimeInstrumentation:
+    def _run_job(self, report_interval_s=None, **metric_kw):
+        import dataclasses
+
+        from flink_tensorflow_tpu import StreamExecutionEnvironment
+
+        env = StreamExecutionEnvironment(parallelism=2)
+        if metric_kw:
+            env.configure(metrics=dataclasses.replace(
+                env.config.metrics, **metric_kw))
+        (env.from_collection(list(range(64)))
+            .rebalance()
+            .map(lambda x: x + 1, name="inc", parallelism=2)
+            .sink_to_list())
+        env.execute("job", timeout=120, report_interval_s=report_interval_s)
+        return env
+
+    def test_per_subtask_metrics_populated(self):
+        env = self._run_job()
+        snap = env.metric_registry.snapshot()
+        for scope in ("inc.0", "inc.1"):
+            m = snap[scope]
+            assert m["records_in"]["count"] == 32
+            assert m["records_out"]["count"] == 32
+            assert m["process_latency_s"]["count"] == 32
+            assert m["queue_depth"] == 0          # drained at job end
+            assert m["queue_high_watermark"] >= 1
+            assert m["backpressure_s"] >= 0.0
+            assert m["idle_s"] >= 0.0
+            assert m["busy_s"] > 0.0
+        # Source: emit latency + records_out.
+        src = snap["collection.0"]
+        assert src["records_out"]["count"] == 64
+        assert src["process_latency_s"]["count"] == 64
+
+    def test_no_reporter_thread_without_interval(self):
+        before = {t.name for t in threading.enumerate()}
+        env = self._run_job(report_interval_s=None)
+        assert "metric-reporter" not in {
+            t.name for t in threading.enumerate()} - before
+        assert env is not None
+
+    def test_reporter_sinks_written_during_execution(self, tmp_path):
+        jsonl = tmp_path / "m.jsonl"
+        prom = tmp_path / "m.prom"
+        self._run_job(report_interval_s=0.02,
+                      jsonl_path=str(jsonl), prometheus_path=str(prom))
+        lines = [json.loads(x) for x in jsonl.read_text().splitlines()]
+        assert lines  # at least the final stop() report
+        assert any("inc.0" in line["metrics"] for line in lines)
+        assert 'scope="inc.0"' in prom.read_text()
+
+    def test_watermark_lag_gauge_on_event_time_pipeline(self):
+        from flink_tensorflow_tpu import StreamExecutionEnvironment
+        from flink_tensorflow_tpu.core import functions as fn
+
+        class Agg(fn.WindowFunction):
+            def process_window(self, key, window, elements, out):
+                out.collect((key, len(elements)))
+
+        env = StreamExecutionEnvironment()
+        (env.from_collection([("k", float(i)) for i in range(40)])
+            .assign_timestamps(lambda e: e[1], watermark_every=4)
+            .key_by(lambda e: e[0])
+            .time_window(5.0)
+            .apply(Agg())
+            .sink_to_list())
+        env.execute("wm", timeout=120)
+        snap = env.metric_registry.snapshot()
+        lag = snap["time_window.0"]["watermark_lag_s"]
+        assert lag is not None and lag >= 0.0
+        assert snap["timestamps.0"]["watermark_lag_s"] is not None
+
+    def test_checkpoint_metrics(self, tmp_path):
+        from flink_tensorflow_tpu import StreamExecutionEnvironment
+        from flink_tensorflow_tpu.io.sources import CollectionSource
+
+        env = StreamExecutionEnvironment()
+        env.enable_checkpointing(str(tmp_path / "chk"), every_n_records=16)
+        (env.from_source(CollectionSource(list(range(64))), name="src")
+            .map(lambda x: x, name="fwd")
+            .sink_to_list())
+        env.execute("chk", timeout=120)
+        chk = env.metric_registry.snapshot()["checkpoint"]
+        assert chk["completed"] >= 1
+        assert chk["duration_s"]["count"] >= 1
+        assert chk["last_checkpoint_id"] >= 1
+        assert chk["last_size_bytes"] > 0
+        # Per-subtask alignment spans recorded on the worker scopes.
+        snap = env.metric_registry.snapshot()
+        assert snap["fwd.0"]["checkpoint_alignment_s"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# inspector CLI
+# ---------------------------------------------------------------------------
+
+REQUIRED_ROW_KEYS = {
+    "operator", "subtask", "records_per_s", "p50_latency_s",
+    "p99_latency_s", "queue_depth", "backpressure_fraction",
+    "watermark_lag_s",
+}
+
+
+class TestInspector:
+    def test_build_rows_shapes(self):
+        from flink_tensorflow_tpu.metrics.inspector import build_rows
+
+        snapshot = {
+            "op.0": {
+                "records_in": {"count": 10, "rate": 5.0, "window_rate": 5.0},
+                "records_out": {"count": 10, "rate": 5.0, "window_rate": 5.0},
+                "process_latency_s": {"count": 10, "p50": 0.01, "p95": 0.02,
+                                      "p99": 0.02, "mean": 0.01,
+                                      "total_s": 0.1},
+                "queue_depth": 2,
+                "queue_high_watermark": 9,
+                "backpressure_s": 0.5,
+            },
+            "checkpoint": {"completed": 1},
+        }
+        rows = build_rows(snapshot, wall_s=2.0)
+        assert len(rows) == 1  # job-level scopes excluded
+        row = rows[0]
+        assert REQUIRED_ROW_KEYS <= set(row)
+        assert row["records_per_s"] == pytest.approx(5.0)
+        assert row["backpressure_fraction"] == pytest.approx(0.25)
+        assert row["watermark_lag_s"] is None
+
+    def test_cli_on_example(self, capsys):
+        from flink_tensorflow_tpu.metrics.inspector import main
+
+        rc = main([str(REPO / "examples/mnist_lenet.py"), "--snapshot-only"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        snap = json.loads(out[-1])
+        assert snap["subtasks"], "expected at least one operator subtask"
+        for row in snap["subtasks"]:
+            assert REQUIRED_ROW_KEYS <= set(row)
+            assert row["records_per_s"] is not None
+            assert row["backpressure_fraction"] is not None
+        # Every operator in the plan shows up with every subtask.
+        ops = {(r["operator"], r["subtask"]) for r in snap["subtasks"]}
+        assert len(ops) == len(snap["subtasks"])
+        assert json.dumps(snap)  # strict-JSON round-trippable
+
+    def test_cli_table_output(self, capsys):
+        from flink_tensorflow_tpu.metrics.inspector import main
+
+        rc = main([str(REPO / "examples/mnist_lenet.py")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "rec/s" in out and "p99 ms" in out
+
+    def test_cli_failure_exit_code(self, capsys, tmp_path):
+        from flink_tensorflow_tpu.metrics.inspector import main
+
+        bad = tmp_path / "nope.py"
+        bad.write_text("def main(argv):\n    return 0\n")
+        assert main([str(bad), "--snapshot-only"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestMetricConfig:
+    def test_validate_rejects_bad_interval(self):
+        with pytest.raises(ValueError):
+            MetricConfig(report_interval_s=0).validate()
+
+    def test_validate_rejects_non_reporter(self):
+        with pytest.raises(ValueError):
+            MetricConfig(reporters=("nope",)).validate()
+
+    def test_build_reporters(self, tmp_path):
+        cfg = MetricConfig(jsonl_path=str(tmp_path / "a.jsonl"),
+                           prometheus_path=str(tmp_path / "a.prom"),
+                           console=True)
+        kinds = {type(r) for r in cfg.build_reporters()}
+        assert kinds == {JsonLinesReporter, PrometheusFileReporter,
+                         ConsoleReporter}
+
+    def test_job_config_carries_metrics(self):
+        from flink_tensorflow_tpu.core.config import JobConfig
+
+        cfg = JobConfig(metrics=MetricConfig(report_interval_s=1.0))
+        assert cfg.validate().metrics.report_interval_s == 1.0
+
+    def test_seed_flows_into_registry(self):
+        import dataclasses
+
+        from flink_tensorflow_tpu import StreamExecutionEnvironment
+
+        env = StreamExecutionEnvironment()
+        env.configure(metrics=dataclasses.replace(
+            env.config.metrics, seed=17))
+        env.from_collection([1, 2, 3]).sink_to_list()
+        env.execute("seeded", timeout=60)
+        assert env.metric_registry.seed == 17
+
+
+def test_prometheus_exposition_is_sorted_and_labelled():
+    from flink_tensorflow_tpu.metrics.reporters import prometheus_exposition
+
+    text = prometheus_exposition(
+        {"b.0": {"x": 1}, "a.0": {"x": 2}}, timestamp=0.0)
+    # Scopes render in sorted order; both carry the scope label.
+    assert text.index('scope="a.0"') < text.index('scope="b.0"')
+
+
+def test_gauge_math_watermark_lag_never_negative():
+    from flink_tensorflow_tpu.core.event_time import _WatermarkLagMixin
+
+    class Holder(_WatermarkLagMixin):
+        ctx = None
+
+    h = Holder()
+    assert h._last_lag_s is None
+    h._note_event_ts(10.0)
+    h._note_watermark(12.0)  # watermark ahead of data (slackless close)
+    assert h._last_lag_s == 0.0
+    h._note_watermark(math.inf)  # closing watermark must not clobber
+    assert h._last_lag_s == 0.0
+    h._note_event_ts(20.0)
+    h._note_watermark(15.0)
+    assert h._last_lag_s == pytest.approx(5.0)
