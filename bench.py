@@ -47,6 +47,37 @@ import numpy as np
 # Stand-in reference throughput (records/sec/GPU) — see module docstring.
 REFERENCE_ESTIMATE_RPS = 150.0
 
+
+def _chaining_enabled(args) -> bool:
+    """Operator chaining on/off for this run: the --chaining flag wins;
+    otherwise the FLINK_TPU_CHAINING env var (off/0/false disables).
+    The off mode is the comparison run that attributes the latency-floor
+    reduction to chaining (one thread + queue hop per operator, the
+    pre-chaining layout)."""
+    if args.chaining is not None:
+        return args.chaining == "on"
+    return os.environ.get("FLINK_TPU_CHAINING", "on").lower() not in (
+        "off", "0", "false")
+
+
+def _apply_chaining(env, args):
+    env.configure(chaining=_chaining_enabled(args))
+    return env
+
+
+def _chain_report(env) -> dict:
+    """The JSON tail's chain attribution: the execution chain topology
+    and whether fusion was on — BENCH_r06 reads both next to the floor
+    components to attribute the reduction."""
+    from flink_tensorflow_tpu.analysis.chaining import compute_chains
+
+    plan = compute_chains(env.graph, enabled=env.config.chaining)
+    return {
+        "chaining": "on" if env.config.chaining else "off",
+        "chains": plan.names(),
+        "chained_edges": plan.chained_edge_count,
+    }
+
 # Prose annotations for the machine-readable ceiling-drift code (the
 # code is the source of truth; prose is presentation only).
 CEILING_DRIFT_PROSE = {
@@ -668,7 +699,7 @@ def bench_inception(args) -> dict:
     dev = jax.devices()[0]
     wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
 
-    env = StreamExecutionEnvironment(parallelism=1)
+    env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
     sink, results, arrivals = _timed_sink()
     (
         env.from_collection(records, parallelism=1)
@@ -787,6 +818,7 @@ def bench_inception(args) -> dict:
         "metric": "inception_v3_streaming_inference_records_per_sec_per_chip",
         "value": round(rps_per_chip, 2),
         "unit": "records/s/chip",
+        **_chain_report(env),
         "vs_baseline": round(rps_per_chip / REFERENCE_ESTIMATE_RPS, 3),
         "p50_record_latency_ms": round(lat.get("p50", float("nan")) * 1e3, 3),
         "p99_record_latency_ms": round(lat.get("p99", float("nan")) * 1e3, 3),
@@ -939,7 +971,8 @@ def bench_inception(args) -> dict:
         cal_window = min(2, ol_batch)
         cal_windows = max(4 * 2 * args.lanes, 24)
         cal_n = min(len(records), cal_windows * cal_window)
-        env_cal = StreamExecutionEnvironment(parallelism=1)
+        env_cal = _apply_chaining(
+            StreamExecutionEnvironment(parallelism=1), args)
         cal_sink, cal_results, cal_arrivals = _timed_sink()
         (
             env_cal.from_collection(records[:cal_n], parallelism=1)
@@ -1022,7 +1055,8 @@ def bench_inception(args) -> dict:
             )
             budget_s = max(requested_budget_s, 1.5 * floor_s)
 
-            env2 = StreamExecutionEnvironment(parallelism=1)
+            env2 = _apply_chaining(
+                StreamExecutionEnvironment(parallelism=1), args)
             samples = []  # (scheduled arrival, latency, stamps or None)
 
             def ol_sink(record):
@@ -1574,7 +1608,7 @@ def bench_mnist(args) -> dict:
 
     dev = jax.devices()[0]
     wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
-    env = StreamExecutionEnvironment(parallelism=1)
+    env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
     sink, results, arrivals = _timed_sink()
     (
         env.from_collection(records, parallelism=1)
@@ -1599,6 +1633,7 @@ def bench_mnist(args) -> dict:
     lat = job.metrics.get("lenet.0.record_latency_s", {})
     out = {
         "metric": "mnist_lenet_microbatch_records_per_sec_per_chip",
+        **_chain_report(env),
         "value": round(rps_per_chip, 2),
         "unit": "records/s/chip",
         "vs_baseline": None,
@@ -1643,7 +1678,7 @@ def bench_bilstm(args) -> dict:
 
     dev = jax.devices()[0]
     wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
-    env = StreamExecutionEnvironment(parallelism=1)
+    env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
     sink, results, arrivals = _timed_sink()
     (
         env.from_collection(records, parallelism=1)
@@ -1668,6 +1703,7 @@ def bench_bilstm(args) -> dict:
     lat = job.metrics.get("bilstm.0.record_latency_s", {})
     out = {
         "metric": "bilstm_streaming_inference_records_per_sec_per_chip",
+        **_chain_report(env),
         "value": round(rps_per_chip, 2),
         "unit": "records/s/chip",
         "vs_baseline": None,
@@ -1725,7 +1761,7 @@ def bench_widedeep(args) -> dict:
 
     dev = jax.devices()[0]
     wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
-    env = StreamExecutionEnvironment(parallelism=1)
+    env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
     sink, results, arrivals = _timed_sink()
     (
         env.from_collection(records, parallelism=1)
@@ -1751,6 +1787,7 @@ def bench_widedeep(args) -> dict:
     record_bytes = sum(a.nbytes for a in records[0].fields.values())
     out = {
         "metric": "widedeep_online_training_steps_per_sec",
+        **_chain_report(env),
         "value": round(steps_per_s, 2),
         "unit": "steps/s",
         "vs_baseline": None,
@@ -1815,7 +1852,7 @@ def bench_resnet(args) -> dict:
 
     dev = jax.devices()[0]
     wire_pre = _wire_probe(dev, smoke=args.smoke, micro=True)
-    env = StreamExecutionEnvironment(parallelism=1)
+    env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
     env.set_mesh(mesh)
     sink, results, arrivals = _timed_sink()
     (
@@ -1835,6 +1872,7 @@ def bench_resnet(args) -> dict:
     record_bytes = sum(a.nbytes for a in records[0].fields.values())
     out = {
         "metric": "resnet50_dp_training_records_per_sec_per_chip",
+        **_chain_report(env),
         "value": round(rps / max(1, n_dev), 2),
         "unit": "records/s/chip",
         "vs_baseline": None,
@@ -1884,10 +1922,20 @@ def main(argv=None):
     p.add_argument("--open-loop-timeout-s", type=float, default=None,
                    help="count-or-timeout window timeout for the open-loop "
                         "pass (default: sized for ~16 records/window)")
-    p.add_argument("--open-loop-idle-flush-s", type=float, default=0.015,
-                   help="ready-poll interval for open-loop result "
-                        "collection (non-blocking; bounds the time a "
-                        "device-complete result waits for emission)")
+    p.add_argument("--open-loop-idle-flush-s", type=float, default=0.002,
+                   help="ready-poll BACKSTOP for open-loop result "
+                        "collection; emission is completion-driven (the "
+                        "fetch thread wakes the subtask's event gate the "
+                        "moment results land), so this bounds only the "
+                        "wake-miss worst case — it no longer prices a "
+                        "fixed 15ms poll into the latency floor")
+    p.add_argument("--chaining", choices=["on", "off"], default=None,
+                   help="operator chaining (default: on, or the "
+                        "FLINK_TPU_CHAINING env var) — 'off' is the "
+                        "comparison mode that re-runs with one thread + "
+                        "queue hop per operator so the floor reduction "
+                        "is attributable; both modes record the chain "
+                        "topology in the JSON tail")
     p.add_argument("--open-loop-start-delay-s", type=float, default=60.0,
                    help="shift the open-loop schedule past pipeline warmup "
                         "(covers one cold XLA compile of the service bucket)")
@@ -2013,6 +2061,7 @@ def _scoreboard(outputs: list) -> dict:
         "vs_baseline": flag.get("vs_baseline"),
         "p50_ms": flag.get("p50_record_latency_ms"),
         "p99_ms": flag.get("p99_record_latency_ms"),
+        "chaining": flag.get("chaining"),
         "full_detail": "BENCH_full.json",
     }
     wire, wire_pre = flag.get("wire") or {}, flag.get("wire_pre") or {}

@@ -14,6 +14,13 @@ All of it runs before a single record is emitted or a chip is touched.
 """
 
 from flink_tensorflow_tpu.analysis.analyzer import analyze, has_errors
+from flink_tensorflow_tpu.analysis.chaining import (
+    ChainPlan,
+    chainable_edge,
+    compute_chains,
+    sharding_axes_of,
+    sharding_fusion_conflict,
+)
 from flink_tensorflow_tpu.analysis.capture import (
     PlanCaptured,
     capture_pipeline_file,
@@ -34,6 +41,7 @@ from flink_tensorflow_tpu.analysis.schema_prop import SchemaFlow, propagate
 __all__ = [
     "RULES",
     "AnalysisContext",
+    "ChainPlan",
     "Diagnostic",
     "LintRule",
     "PlanCaptured",
@@ -44,10 +52,14 @@ __all__ = [
     "capture_pipeline_file",
     "capture_plan",
     "capturing_execution",
+    "chainable_edge",
+    "compute_chains",
     "edge_name",
     "format_diagnostics",
     "has_errors",
     "propagate",
     "rule",
+    "sharding_axes_of",
+    "sharding_fusion_conflict",
     "worst_severity",
 ]

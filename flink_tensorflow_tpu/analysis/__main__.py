@@ -17,6 +17,7 @@ import sys
 
 from flink_tensorflow_tpu.analysis.analyzer import analyze, has_errors
 from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
+from flink_tensorflow_tpu.analysis.chaining import compute_chains
 from flink_tensorflow_tpu.analysis.diagnostics import format_diagnostics
 
 
@@ -46,10 +47,13 @@ def main(argv=None) -> int:
             exit_code = max(exit_code, 2)
             continue
         diags = analyze(env.graph, config=env.config)
+        plan = compute_chains(env.graph, enabled=env.config.chaining)
         if args.json:
             print(json.dumps({
                 "pipeline": path,
                 "operators": len(env.graph.transformations),
+                "chains": plan.names(),
+                "chained_edges": plan.chained_edge_count,
                 "diagnostics": [
                     {"rule": d.rule, "severity": d.severity.name,
                      "message": d.message, "node": d.node, "edge": d.edge}
@@ -58,7 +62,9 @@ def main(argv=None) -> int:
             }))
         else:
             n = len(env.graph.transformations)
-            print(f"== {path} ({n} operators) ==")
+            print(f"== {path} ({n} operators, "
+                  f"{len(plan.chains)} chain(s)) ==")
+            print(plan.format_topology())
             print(format_diagnostics(diags))
         if has_errors(diags):
             exit_code = max(exit_code, 1)

@@ -8,8 +8,6 @@ transformation (factories are cheap — ``open()`` is never called, so no
 device or model state is touched), the propagated schemas, and the
 job config when the caller provided one.
 
-Deferred (ROADMAP "Open items"): sharding-axis lints (NamedSharding
-annotations vs mesh axes).
 """
 
 from __future__ import annotations
@@ -343,6 +341,95 @@ def _watermark_async_flush(ctx: AnalysisContext, emit: Emit) -> None:
                     node=d.name,
                 )
             stack.extend(ctx.graph.downstream_of(d))
+
+
+@rule("sharding-axis", Severity.ERROR)
+def _sharding_axis(ctx: AnalysisContext, emit: Emit) -> None:
+    """Sharding annotations must agree with the mesh BEFORE execution:
+    a declared batch-dim sharding axis that doesn't exist on the mesh
+    compiles against a silently-replicated (size-1) axis, and a batch
+    that doesn't divide the sharded axes' device product makes the first
+    pjit call fail (or hang a collective) after the job already started.
+    Shares its annotation vocabulary (``sharding_axes``, gang defaults)
+    with the operator-chaining pass — analysis/chaining.py refuses to
+    fuse across mismatched axes using the same helpers."""
+    from flink_tensorflow_tpu.analysis.chaining import (
+        sharding_axes_of,
+        sharding_fusion_conflict,
+    )
+
+    mesh = ctx.config.mesh if ctx.config is not None else None
+    mesh_axes = dict(mesh.shape) if mesh is not None else None
+    for t in ctx.order:
+        function = ctx.function_of(t)
+        axes = sharding_axes_of(function)
+        if axes is None:
+            continue
+        is_gang = getattr(function, "is_gang", False)
+        if mesh is None:
+            if ctx.config is not None and not is_gang:
+                # Gang ops get the missing-mesh ERROR from
+                # mesh-divisibility; annotated non-gang ops need their own.
+                emit(
+                    f"operator declares sharding axes {list(axes)} but the "
+                    "job has no mesh — annotate via env.set_mesh(...) or "
+                    "drop the annotation",
+                    node=t.name,
+                )
+            continue
+        unknown = [a for a in axes if a not in mesh_axes]
+        if unknown:
+            emit(
+                f"sharding axes {unknown} are not on the mesh "
+                f"(mesh axes: {sorted(mesh_axes)}) — the annotation would "
+                "compile against a silently-replicated axis; fix the "
+                "annotation or add the axis to the mesh",
+                node=t.name,
+            )
+            continue
+        # Batch-dim divisibility over the DECLARED axes.  Gang functions'
+        # global_batch vs the data axis is mesh-divisibility's finding;
+        # this rule owns every other annotated operator.
+        if is_gang:
+            continue
+        batch = getattr(function, "global_batch", None)
+        if batch is None:
+            policy = _plan_policy(function)
+            batch = getattr(policy, "fixed_batch", None) if policy else None
+        if batch is not None:
+            shard_product = 1
+            for a in axes:
+                shard_product *= mesh_axes[a]
+            if shard_product and batch % shard_product:
+                emit(
+                    f"batch {batch} does not divide the sharded axes' "
+                    f"device product ({'x'.join(axes)} = {shard_product}) — "
+                    "per-device shards would be ragged; pick a multiple",
+                    node=t.name,
+                )
+    # The shared fusion check, surfaced as a lint: a forward edge whose
+    # endpoints BOTH declare sharding — but disagree — cannot chain
+    # (records would hop between differently-placed steps on the same
+    # thread) and is usually an accidental annotation mismatch.  An
+    # annotated operator next to a plain host-side one is normal and
+    # stays quiet (the chaining pass still declines to fuse it).
+    for t in ctx.order:
+        for e in t.inputs:
+            if not isinstance(e.partitioner, ForwardPartitioner):
+                continue
+            up_fn = ctx.function_of(e.upstream)
+            down_fn = ctx.function_of(t)
+            up_axes = sharding_axes_of(up_fn)
+            down_axes = sharding_axes_of(down_fn)
+            if (up_axes is not None and down_axes is not None
+                    and up_axes != down_axes):
+                conflict = sharding_fusion_conflict(
+                    ctx.operators.get(e.upstream.id), ctx.operators.get(t.id))
+                emit(
+                    f"forward edge will not chain: {conflict}",
+                    node=t.name, edge=_edge_str(e, t),
+                    severity=Severity.WARN,
+                )
 
 
 @rule("recompile-churn", Severity.WARN)

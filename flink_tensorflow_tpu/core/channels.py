@@ -5,32 +5,41 @@ communication backend") scoped to one host: bounded queues give backpressure;
 each downstream subtask owns one :class:`InputGate` merging the channels from
 all upstream subtasks, which is where checkpoint-barrier alignment happens.
 
+The gate is **event-driven**: readers block on a condition variable and are
+woken by the first put (or :meth:`wake`, or :meth:`close`) — there is no
+timed poll interval anywhere on the record plane, so an idle hop costs a
+wakeup latency of one ``notify``, not a 50 ms sleep quantum (the
+``collection_poll`` / idle-poll floor components of BENCH_r05).  Writers
+blocked on a full queue are likewise woken by the consuming ``poll``.
+
 Only host objects (numpy buffers, metadata) cross channels.  Device arrays
 stay in HBM inside the model operators — moving ``jax.Array``s through the
 record plane would serialize HBM traffic through the host and throw away the
 zero-copy design (BASELINE.json:4).
 
-A native C++ ring-buffer backend can replace :class:`QueueChannel` without
-touching the gate protocol (see native/ — SURVEY.md §2 notes the reference's
-only native component is the external TF core; ours is the channel layer).
+A native C++ ring-buffer backend can replace the deque without touching the
+gate protocol (see native/ — SURVEY.md §2 notes the reference's only native
+component is the external TF core; ours is the channel layer).
+
+Operator chaining (analysis/chaining.py + core/runtime.py) removes this
+layer entirely from forward same-parallelism hops: chained operators pass
+records by direct method call and no gate exists between them.
 """
 
 from __future__ import annotations
 
 import collections
-import queue
 import threading
+import time
 import typing
 
 from flink_tensorflow_tpu.core import elements as el
-
-_POLL_INTERVAL_S = 0.05
 
 
 class InputGate:
     """Merged input for one subtask: N channels + barrier alignment.
 
-    Writers push ``(channel_idx, element)`` into a shared bounded queue.
+    Writers push ``(channel_idx, element)`` into a shared bounded deque.
     Per-channel FIFO order is preserved because each writer is a single
     thread.  During barrier alignment, elements from already-barriered
     channels are stashed and replayed after the checkpoint completes —
@@ -39,25 +48,37 @@ class InputGate:
 
     def __init__(self, num_channels: int, capacity: int = 1024):
         self.num_channels = num_channels
-        self._queue: "queue.Queue[typing.Tuple[int, el.StreamElement]]" = queue.Queue(
-            maxsize=capacity
+        self.capacity = capacity
+        self._queue: typing.Deque[typing.Tuple[int, el.StreamElement]] = (
+            collections.deque()
         )
         self._stashed: typing.List[typing.Deque[typing.Tuple[int, el.StreamElement]]] = [
             collections.deque() for _ in range(num_channels)
         ]
         self._replay: typing.Deque[typing.Tuple[int, el.StreamElement]] = collections.deque()
         self._blocked: typing.List[bool] = [False] * num_channels
-        self._closed = threading.Event()
+        self._closed = False
+        #: One lock, two wait-sets: readers park on ``_not_empty`` (woken
+        #: by put/wake/close), writers on ``_not_full`` (woken by poll's
+        #: dequeue and by close) — fully event-driven, no poll quantum.
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
         # -- observability (metrics/: pull-based gauges read these) ------
-        #: Deepest queue occupancy ever observed at a put (monotone max;
-        #: updated without a lock — a lost race only understates it by
-        #: one sample, and the fast path must stay cheap).
+        #: Deepest queue occupancy ever observed at a put (monotone max).
         self.high_watermark = 0
         #: Total seconds writers spent blocked on a full queue — the
-        #: backpressure signal.  Guarded by ``_stats_lock``: the blocked
-        #: path is already slow, so a lock there costs nothing.
+        #: backpressure signal.
         self.blocked_put_s = 0.0
-        self._stats_lock = threading.Lock()
+        #: Per-channel cumulative put counts — the record plane's
+        #: PER-EDGE traffic counters (the executor maps channel ranges
+        #: back to logical edges for the ``edge*_queue_puts`` gauges;
+        #: a chained edge has no gate, hence provably zero queue puts).
+        self.puts_per_channel: typing.List[int] = [0] * num_channels
+        #: Per-channel elements currently buffered anywhere in the gate
+        #: (queue + alignment stash + replay) — decremented only when
+        #: poll hands the element to the operator.
+        self.buffered_per_channel: typing.List[int] = [0] * num_channels
         #: Wake sentinels currently sitting in the queue — subtracted
         #: from the depth gauge so they never read as buffered records.
         self._wake_sentinels = 0
@@ -67,69 +88,78 @@ class InputGate:
         """Enqueue; returns seconds spent blocked on a full queue (0.0 on
         the uncontended fast path — callers attribute it to the WRITING
         subtask's backpressure time)."""
-        try:
-            self._queue.put_nowait((channel_idx, element))
-        except queue.Full:
-            pass
-        else:
-            depth = self._queue.qsize()
+        with self._not_full:
+            if len(self._queue) < self.capacity or self._closed:
+                blocked = 0.0
+            else:
+                t0 = time.monotonic()
+                while len(self._queue) >= self.capacity and not self._closed:
+                    self._not_full.wait()
+                blocked = time.monotonic() - t0
+                self.blocked_put_s += blocked
+            if self._closed:
+                # Gate torn down (job cancelled/finished): drop silently.
+                return blocked
+            self._queue.append((channel_idx, element))
+            self.puts_per_channel[channel_idx] += 1
+            self.buffered_per_channel[channel_idx] += 1
+            depth = len(self._queue)
             if depth > self.high_watermark:
                 self.high_watermark = depth
-            return 0.0
-        t0 = _now()
-        try:
-            while not self._closed.is_set():
-                try:
-                    self._queue.put((channel_idx, element), timeout=_POLL_INTERVAL_S)
-                    return _now() - t0
-                except queue.Full:
-                    continue
-            # Gate torn down (job cancelled/finished): drop silently.
-            return _now() - t0
-        finally:
-            with self._stats_lock:
-                self.blocked_put_s += _now() - t0
+            self._not_empty.notify()
+            return blocked
 
     def wake(self) -> None:
         """Break a blocked :meth:`poll` immediately.
 
         For operator-owned background threads (e.g. the model runner's
         fetch thread) whose completions should be handled NOW rather
-        than after the subtask loop's poll timeout expires.  The sentinel
-        makes ``poll`` return None early; the loop then re-evaluates the
-        operator's ``next_deadline`` and fires.  Lossless: no stream
-        element is consumed or reordered."""
-        try:
-            self._queue.put_nowait((-1, None))
-        except queue.Full:
-            pass  # a full queue wakes the reader on its own
-        else:
+        than after the subtask loop's deadline wait expires.  The
+        sentinel makes ``poll`` return None early; the loop then
+        re-evaluates the operator's ``next_deadline`` and fires.
+        Lossless: no stream element is consumed or reordered."""
+        with self._not_empty:
+            self._queue.append((-1, None))
             self._wake_sentinels += 1
+            self._not_empty.notify()
 
     # -- reader side (single consumer thread) --------------------------
     def poll(self, timeout: typing.Optional[float] = None) -> typing.Optional[typing.Tuple[int, el.StreamElement]]:
-        """Next (channel, element) honoring blocked channels; None on timeout."""
+        """Next (channel, element) honoring blocked channels.
+
+        Blocks event-driven: ``timeout=None`` waits until a put /
+        :meth:`wake` / :meth:`close` arrives (no timed re-poll).  Returns
+        None on timeout, wake sentinel, or a closed-and-empty gate.
+        """
         while self._replay:
             idx, element = self._replay.popleft()
             if self._blocked[idx]:
                 self._stashed[idx].append((idx, element))
                 continue
+            self.buffered_per_channel[idx] -= 1
             return idx, element
-        deadline = None if timeout is None else (_now() + timeout)
+        deadline = None if timeout is None else (time.monotonic() + timeout)
         while True:
-            remaining = None if deadline is None else max(0.0, deadline - _now())
-            try:
-                idx, element = self._queue.get(timeout=remaining if remaining is not None else _POLL_INTERVAL_S)
-            except queue.Empty:
-                if deadline is not None and _now() >= deadline:
-                    return None
-                continue
-            if idx < 0:
-                self._wake_sentinels -= 1
-                return None  # wake() sentinel: hand control back NOW
+            with self._not_empty:
+                while not self._queue:
+                    if self._closed:
+                        return None
+                    if deadline is None:
+                        self._not_empty.wait()
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or not self._not_empty.wait(remaining):
+                            if not self._queue:
+                                return None
+                idx, element = self._queue.popleft()
+                self._not_full.notify()
+                if idx < 0:
+                    self._wake_sentinels -= 1
+                    return None  # wake() sentinel: hand control back NOW
             if self._blocked[idx]:
                 self._stashed[idx].append((idx, element))
                 continue
+            self.buffered_per_channel[idx] -= 1
             return idx, element
 
     def block_channel(self, idx: int) -> None:
@@ -143,7 +173,10 @@ class InputGate:
             self._replay.extend(dq)
 
     def close(self) -> None:
-        self._closed.set()
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     @property
     def any_blocked(self) -> bool:
@@ -155,15 +188,17 @@ class InputGate:
         replay, minus un-consumed wake sentinels) — the queue-depth
         gauge.  Approximate under concurrent mutation; reporters
         tolerate off-by-a-few."""
-        return max(0, self._queue.qsize() + len(self._replay)
+        return max(0, len(self._queue) + len(self._replay)
                    + sum(len(d) for d in self._stashed)
                    - self._wake_sentinels)
 
+    def channel_depth(self, idx: int) -> int:
+        """Buffered elements attributable to channel ``idx`` — the
+        per-edge depth gauges sum these over an edge's channel range."""
+        return max(0, self.buffered_per_channel[idx])
 
-def _now() -> float:
-    import time
-
-    return time.monotonic()
+    def channel_puts(self, idx: int) -> int:
+        return self.puts_per_channel[idx]
 
 
 class ChannelWriter:

@@ -355,23 +355,27 @@ class CheckpointCoordinator:
                         self._complete_locked(pending)
 
     def subtask_finished(self, subtask: "_Subtask") -> None:
-        key = (subtask.t.name, subtask.index)
+        # One final snapshot per LOGICAL operator: a chained subtask
+        # carries several fused operators (core/runtime._ChainedUnit),
+        # each with its own (task, index) checkpoint identity.
         with self._lock:
-            try:
-                snap = subtask.operator.snapshot()
-            except Exception:  # pragma: no cover - state already released
-                snap = None
-            self._final_snapshots[key] = snap
-            for cid, pending in list(self._pending.items()):
-                if subtask.index not in pending.snapshots.get(subtask.t.name, {}):
-                    pending.snapshots.setdefault(subtask.t.name, {})[subtask.index] = snap
-                    pending.acks += 1
-                    if pending.acks >= pending.expected:
-                        pending.done.set()
-                        if pending.source_initiated:
-                            del self._pending[cid]
-                            if not pending.failed:
-                                self._complete_locked(pending)
+            for unit in subtask.units:
+                key = (unit.t.name, unit.index)
+                try:
+                    snap = unit.operator.snapshot()
+                except Exception:  # pragma: no cover - state already released
+                    snap = None
+                self._final_snapshots[key] = snap
+                for cid, pending in list(self._pending.items()):
+                    if unit.index not in pending.snapshots.get(unit.t.name, {}):
+                        pending.snapshots.setdefault(unit.t.name, {})[unit.index] = snap
+                        pending.acks += 1
+                        if pending.acks >= pending.expected:
+                            pending.done.set()
+                            if pending.source_initiated:
+                                del self._pending[cid]
+                                if not pending.failed:
+                                    self._complete_locked(pending)
 
     def cancel_pending(self) -> None:
         with self._lock:

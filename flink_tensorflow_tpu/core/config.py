@@ -97,6 +97,13 @@ class JobConfig:
     max_parallelism: int = 128
     #: Bounded capacity of inter-subtask channels (records).
     channel_capacity: int = 1024
+    #: Operator chaining (analysis/chaining.py): fuse forward
+    #: same-parallelism neighbors into one subtask thread so records
+    #: pass by direct method call instead of a queue hop.  Off is the
+    #: ``chaining=off`` comparison mode (one thread + channel per
+    #: operator, the pre-chaining layout); per-operator opt-outs are
+    #: ``stream.start_new_chain()`` / ``stream.disable_chaining()``.
+    chaining: bool = True
     #: Sleep between source emissions — test/backpressure pacing.
     source_throttle_s: float = 0.0
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)

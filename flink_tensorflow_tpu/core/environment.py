@@ -294,6 +294,7 @@ class StreamExecutionEnvironment:
             checkpoint_timeout_s=cfg.checkpoint.timeout_s,
             checkpoint_retain_last=cfg.checkpoint.retain_last,
             max_parallelism=cfg.max_parallelism,
+            chaining=cfg.chaining,
         )
         if cfg.distributed is not None:
             from flink_tensorflow_tpu.core.distributed import DistributedExecutor

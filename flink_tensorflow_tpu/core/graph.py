@@ -56,6 +56,12 @@ class Transformation:
     #: ``schema_fn(input_schema) -> output_schema`` (None = unknown, which
     #: stops propagation past this node without failing it).
     schema_fn: typing.Optional[typing.Callable] = None
+    #: Operator-chaining escape hatches (Flink's startNewChain /
+    #: disableChaining — see analysis/chaining.py): ``chain_start`` pins
+    #: this operator as the head of a new chain (its input edge is never
+    #: fused); ``chainable=False`` keeps it out of chains on BOTH sides.
+    chain_start: bool = False
+    chainable: bool = True
 
     def __hash__(self) -> int:
         return self.id

@@ -139,6 +139,17 @@ class Operator:
     def fire_due(self, now: float) -> None:  # noqa: B027
         """Called by the subtask loop when ``next_deadline`` has passed."""
 
+    @property
+    def uses_timers(self) -> bool:
+        """Whether this operator may ever declare a wall-clock deadline
+        (``next_deadline``/``fire_due``).  The chaining pass
+        (analysis/chaining.py) refuses to fuse timer-driven operators
+        into SOURCE chains — a source loop blocks inside the user
+        function's sleeps and cannot serve deadlines promptly, while a
+        worker chain's loop waits event-driven until the chain's
+        earliest deadline."""
+        return False
+
     # -- snapshot protocol ----------------------------------------------
     def snapshot(self, checkpoint_id: typing.Optional[int] = None) -> typing.Dict[str, typing.Any]:
         """``checkpoint_id`` is the id this snapshot belongs to (None for
@@ -349,6 +360,10 @@ class MapOperator(_FunctionOperator):
         if self._async:
             self.function.fire_due(now)
 
+    @property
+    def uses_timers(self):
+        return self._async
+
 
 class FlatMapOperator(_FunctionOperator):
     def process_record(self, record):
@@ -383,6 +398,10 @@ class ProcessOperator(_FunctionOperator):
 
     def register_timer(self, key, timestamp: float) -> None:
         self._timers[(key, timestamp)] = None
+
+    @property
+    def uses_timers(self):
+        return True  # the ProcessContext may register timers at any record
 
     def process_record(self, record):
         if self.key_selector is not None:
@@ -472,6 +491,10 @@ class CoProcessOperator(_FunctionOperator):
 
     def register_timer(self, key, timestamp: float) -> None:
         self._timers[(key, timestamp)] = None
+
+    @property
+    def uses_timers(self):
+        return True  # the ProcessContext may register timers at any record
 
     def process_record(self, record):  # pragma: no cover - indexed dispatch only
         raise RuntimeError("two-input operator requires process_record_from")
@@ -630,6 +653,11 @@ class WindowOperator(_FunctionOperator):
             nxt.timestamps = list(buf.timestamps[-keep:])
             nxt.first_element_time = time.monotonic()
             self._buffers[key] = nxt
+
+    @property
+    def uses_timers(self):
+        return (self.trigger.has_deadlines()
+                or getattr(self.function, "next_deadline", None) is not None)
 
     def next_deadline(self):
         deadlines = [

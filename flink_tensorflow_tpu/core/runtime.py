@@ -1,10 +1,25 @@
 """Local multi-subtask executor — the TaskManager equivalent.
 
 The reference runs on Flink's JobManager/TaskManager cluster (SURVEY.md §1
-L1); jobs are threads-in-one-process here, one thread per operator subtask
-(the reference's "task slot").  Threads, not asyncio, because the hot path
-blocks in XLA device execution which releases the GIL — a subtask spending
-its time inside ``jax.jit``-compiled calls runs truly parallel to the others.
+L1); jobs are threads-in-one-process here, one thread per operator CHAIN
+(the reference's "task slot" after Flink's operator chaining).  Threads,
+not asyncio, because the hot path blocks in XLA device execution which
+releases the GIL — a subtask spending its time inside ``jax.jit``-compiled
+calls runs truly parallel to the others.
+
+Operator chaining (analysis/chaining.py): forward-partitioned,
+same-parallelism neighbors fuse into one subtask and records pass between
+them by direct method call through :class:`ChainedOutput` — no queue, no
+serialization, no thread wakeup.  Barriers snapshot each chained operator
+in stream order before moving on, watermarks traverse the operators' own
+``process_watermark`` hooks, and every logical operator keeps its own
+metric scope, so exactly-once semantics and per-operator observability
+are untouched by fusion.
+
+The record plane between chains is event-driven end to end: the worker
+loop blocks on its input gate until a put / wake / close or the chain's
+earliest operator deadline — there is no timed idle poll (the 50 ms
+``_IDLE_POLL_S`` of BENCH_r05's latency floor is gone).
 
 The mapping to TPU topology (SURVEY.md §7 step 4): subtask index -> local
 chip for operator-DP inference; gang operators instead share one
@@ -36,8 +51,6 @@ from flink_tensorflow_tpu.metrics.registry import MetricRegistry
 
 logger = logging.getLogger(__name__)
 
-_IDLE_POLL_S = 0.05
-
 
 class JobFailure(RuntimeError):
     pass
@@ -48,44 +61,136 @@ class JobTimeout(JobFailure):
     strategies must propagate it instead of replaying a healthy job."""
 
 
+class _ChainedUnit:
+    """One logical operator inside a chain's subtask.
+
+    Each unit keeps its own metric scope (records in/out, latency) and
+    its own checkpoint identity ``(t.name, index)`` — the inspector and
+    the snapshot store see per-operator numbers whether or not the
+    operator shares a thread with its neighbors."""
+
+    __slots__ = ("t", "index", "operator", "output", "records_in", "latency")
+
+    def __init__(self, t: Transformation, index: int, operator: Operator):
+        self.t = t
+        self.index = index
+        self.operator = operator
+        self.output: typing.Optional[typing.Any] = None
+        self.records_in = None   # Meter
+        self.latency = None      # Timer
+
+    @property
+    def scope(self) -> str:
+        return f"{self.t.name}.{self.index}"
+
+
+class ChainedOutput:
+    """Output of a non-tail chained operator: invokes the next operator
+    in the chain directly on the same thread — the queue-free hop.
+
+    - records: ``emit`` wraps the value and calls the downstream
+      operator's ``process`` inline; per-operator meters/timers still
+      tick (latency is INCLUSIVE of the downstream's own chained
+      emissions — the chain runs synchronously, like Flink's).
+    - barriers: the downstream operator snapshots and acks BEFORE the
+      barrier moves further down the chain — everything it processed
+      precedes the barrier by construction (synchronous direct calls),
+      so aligned exactly-once semantics are byte-identical to the
+      channel path.
+    - watermarks traverse ``process_watermark`` (operators flush
+      event-time state, then forward on their own output).
+    - end-of-partition runs the downstream ``finish()`` flush, then
+      forwards — the tail's real Output broadcasts to the next chains.
+    """
+
+    __slots__ = ("_subtask", "_unit", "_records_out")
+
+    def __init__(self, subtask: "_Subtask", unit: _ChainedUnit, records_out):
+        self._subtask = subtask
+        self._unit = unit
+        self._records_out = records_out  # upstream operator's out-meter
+
+    def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
+        unit = self._unit
+        t0 = time.monotonic()
+        unit.operator.process_record_from(0, el.StreamRecord(value, timestamp))
+        unit.latency.update(time.monotonic() - t0)
+        unit.records_in.mark()
+        if self._records_out is not None:
+            self._records_out.mark()
+
+    def broadcast_element(self, element: el.StreamElement) -> None:
+        unit = self._unit
+        if isinstance(element, el.Watermark):
+            unit.operator.process_watermark(element)
+        elif isinstance(element, el.CheckpointBarrier):
+            self._subtask.snapshot_unit(unit, element.checkpoint_id)
+            unit.output.broadcast_element(element)
+        elif isinstance(element, el.EndOfPartition):
+            unit.operator.finish()
+            unit.output.broadcast_element(element)
+        else:  # pragma: no cover - no other control elements exist
+            unit.output.broadcast_element(element)
+
+    @property
+    def has_downstream(self) -> bool:
+        return True
+
+
 class _Subtask:
+    """One executor thread: a chain of operators sharing one input gate.
+
+    ``chain``/``operators`` hold the fused members head-first; a
+    degenerate single-member chain is exactly the pre-chaining subtask.
+    Head-centric attributes (``t``, ``operator``, ``output``) refer to
+    the chain head — the thread body reads the gate for the head and the
+    chain propagates everything else by direct call.
+    """
+
     def __init__(
         self,
         executor: "LocalExecutor",
-        transformation: Transformation,
+        chain: typing.Sequence[Transformation],
         index: int,
-        operator: Operator,
+        operators: typing.Sequence[Operator],
         gate: typing.Optional[InputGate],
         num_input_channels: int,
         edge_of_channel: typing.Optional[typing.List[int]] = None,
     ):
         self.executor = executor
-        self.t = transformation
+        self.units = [
+            _ChainedUnit(t, index, op) for t, op in zip(chain, operators)
+        ]
+        self.t = chain[0]
         self.index = index
-        self.operator = operator
+        self.operator = operators[0]
         self.gate = gate
         self.num_input_channels = num_input_channels
         #: channel index -> logical input (edge) index, for two-input
         #: operators (connect/join).
         self.edge_of_channel = edge_of_channel or [0] * num_input_channels
-        self.output: typing.Optional[Output] = None
         self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
         self._control_lock = threading.Lock()
         #: Completed-and-durable checkpoint ids awaiting delivery to the
-        #: operator on ITS thread (single-writer contract; Flink mailbox).
+        #: operators on THEIR thread (single-writer contract; Flink mailbox).
         self._notifications: "typing.List[int]" = []
         self.thread: typing.Optional[threading.Thread] = None
         self.finished = threading.Event()
         # -- instrumentation (wired by the executor in _build) -----------
         #: Single-writer accumulators behind this subtask's pull gauges.
         self.stats = SubtaskStats()
-        self.records_in = None      # Meter (workers only)
+        self.records_in = None      # Meter (workers only; head operator)
         self.latency = None         # Timer: per-record processing/emit time
         self.alignment = None       # Timer: barrier-alignment spans
 
     @property
     def scope(self) -> str:
         return f"{self.t.name}.{self.index}"
+
+    @property
+    def output(self):
+        """The chain HEAD's output (a ChainedOutput when fused)."""
+        return self.units[0].output
 
     # --- source control -------------------------------------------------
     def request_checkpoint(self, checkpoint_id: int) -> None:
@@ -105,13 +210,45 @@ class _Subtask:
         with self._control_lock:
             pending, self._notifications = self._notifications, []
         for cid in pending:
-            self.operator.notify_checkpoint_complete(cid)
+            for unit in self.units:
+                unit.operator.notify_checkpoint_complete(cid)
+
+    # --- chain helpers ----------------------------------------------------
+    def _open_chain(self) -> None:
+        """Open tail-to-head so every operator's downstream is live
+        before its first record (Flink's chain open order)."""
+        for unit in reversed(self.units):
+            unit.operator.open()
+
+    def _close_chain(self) -> None:
+        for unit in self.units:
+            unit.operator.close()
+
+    def _chain_next_deadline(self) -> typing.Optional[float]:
+        deadlines = [
+            d for d in (u.operator.next_deadline() for u in self.units)
+            if d is not None
+        ]
+        return min(deadlines) if deadlines else None
+
+    def _chain_fire_due(self, now: float) -> None:
+        for unit in self.units:
+            d = unit.operator.next_deadline()
+            if d is not None and now >= d:
+                unit.operator.fire_due(now)
+
+    def snapshot_unit(self, unit: _ChainedUnit, checkpoint_id: typing.Optional[int]) -> None:
+        """Snapshot + ack ONE chained logical operator (called by
+        ChainedOutput as the barrier traverses the chain in order)."""
+        snapshot = unit.operator.snapshot(checkpoint_id)
+        self.executor.coordinator.ack(
+            checkpoint_id, unit.t.name, unit.index, snapshot)
 
     # --- thread bodies ---------------------------------------------------
     def run_source(self) -> None:
         op = typing.cast(SourceOperator, self.operator)
         try:
-            op.open()
+            self._open_chain()
             throttle = self.executor.source_throttle_s
             every_n = self.executor.checkpoint_every_n
             for value in op.iterate():
@@ -127,7 +264,9 @@ class _Subtask:
                 self.output.emit(value)
                 op.record_emitted()
                 # Per-record emit latency: dominated by blocked-put time
-                # when downstream backpressures (the source-side signal).
+                # when downstream backpressures (the source-side signal);
+                # for a chained source it covers the fused operators'
+                # inline processing.
                 self.latency.update(time.monotonic() - t_emit)
                 # Count-based barriers: checkpoint k cuts the stream after
                 # this subtask's k*N-th record — a deterministic position,
@@ -146,7 +285,7 @@ class _Subtask:
                 self.output.broadcast_element(el.CheckpointBarrier(cid))
             op.finish()
             self.output.broadcast_element(el.EndOfPartition())
-            op.close()
+            self._close_chain()
         except BaseException as exc:  # noqa: BLE001
             self.executor.fail(self, exc)
         finally:
@@ -168,12 +307,16 @@ class _Subtask:
         records_in = self.records_in
         latency = self.latency
         try:
-            op.open()
+            self._open_chain()
             active = n
             while active > 0 and not self.executor.cancelled.is_set():
-                deadline = op.next_deadline()
+                deadline = self._chain_next_deadline()
                 now = time.monotonic()
-                timeout = _IDLE_POLL_S if deadline is None else max(0.0, min(deadline - now, _IDLE_POLL_S))
+                # Event-driven wait: block until a put/wake/close or the
+                # chain's earliest operator deadline — no idle poll
+                # quantum (the gate's condition variable replaces the
+                # former 50 ms _IDLE_POLL_S re-poll).
+                timeout = None if deadline is None else max(0.0, deadline - now)
                 poll_start = now
                 item = gate.poll(timeout=timeout)
                 self._deliver_notifications()
@@ -185,7 +328,7 @@ class _Subtask:
                     # either way).
                     stats.idle_s += now - poll_start
                 if deadline is not None and now >= deadline:
-                    op.fire_due(now)
+                    self._chain_fire_due(now)
                 if item is None:
                     continue
                 idx, element = item
@@ -241,7 +384,7 @@ class _Subtask:
             if not self.executor.cancelled.is_set():
                 op.finish()
                 self.output.broadcast_element(el.EndOfPartition())
-            op.close()
+            self._close_chain()
         except BaseException as exc:  # noqa: BLE001
             self.executor.fail(self, exc)
         finally:
@@ -249,8 +392,7 @@ class _Subtask:
             self.executor.subtask_finished(self)
 
     def _snapshot_and_ack(self, checkpoint_id: int) -> None:
-        snapshot = self.operator.snapshot(checkpoint_id)
-        self.executor.coordinator.ack(checkpoint_id, self.t.name, self.index, snapshot)
+        self.snapshot_unit(self.units[0], checkpoint_id)
 
 
 class LocalExecutor:
@@ -271,6 +413,7 @@ class LocalExecutor:
         checkpoint_timeout_s: float = 60.0,
         checkpoint_retain_last: typing.Optional[int] = None,
         max_parallelism: int = 128,
+        chaining: bool = True,
     ):
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
 
@@ -285,11 +428,15 @@ class LocalExecutor:
         self.checkpoint_timeout_s = checkpoint_timeout_s
         self.checkpoint_retain_last = checkpoint_retain_last
         self.max_parallelism = max_parallelism
+        self.chaining = chaining
         self.cancelled = threading.Event()
         self._error: typing.Optional[BaseException] = None
         self._error_lock = threading.Lock()
         self.subtasks: typing.List[_Subtask] = []
         self._gates: typing.List[InputGate] = []
+        #: The chaining decision (analysis.chaining.ChainPlan) — the
+        #: inspector/analysis CLIs print its topology.
+        self.chain_plan = None
         self.coordinator = CheckpointCoordinator(self, checkpoint_dir)
         self.checkpoint_interval_s: typing.Optional[float] = None
         self._finished_count = 0
@@ -299,7 +446,7 @@ class LocalExecutor:
 
     # --- plan construction ----------------------------------------------
     def _build(self) -> None:
-        by_transformation: typing.Dict[int, typing.List[_Subtask]] = {}
+        by_head: typing.Dict[int, typing.List[_Subtask]] = {}
         gates: typing.Dict[typing.Tuple[int, int], InputGate] = {}
 
         try:
@@ -313,6 +460,7 @@ class LocalExecutor:
             )
             raise
 
+        from flink_tensorflow_tpu.analysis.chaining import compute_chains
         from flink_tensorflow_tpu.core.partitioning import HashPartitioner
 
         for t in order:
@@ -327,13 +475,22 @@ class LocalExecutor:
                     "JobConfig.max_parallelism"
                 )
 
-        # Pass 1: channel layout per downstream transformation.
-        # Forward edges contribute 1 channel per gate; others contribute
-        # the upstream parallelism.
-        channel_base: typing.Dict[typing.Tuple[int, int], int] = {}  # (down_id, edge_idx) -> base
+        # The chaining decision is a pure function of the graph, so every
+        # process of a distributed cohort computes the identical plan and
+        # channel layouts agree cluster-wide.
+        plan = compute_chains(self.graph, enabled=self.chaining)
+        self.chain_plan = plan
+        chain_by_head = {chain[0].id: chain for chain in plan.chains}
+        heads = [t for t in order if t.id in chain_by_head]
+
+        # Pass 1: channel layout per chain HEAD (chained edges pass
+        # records by direct call and get no channels at all).  Forward
+        # edges contribute 1 channel per gate; others contribute the
+        # upstream parallelism.
+        channel_base: typing.Dict[typing.Tuple[int, int], int] = {}  # (head_id, edge_idx) -> base
         gate_size: typing.Dict[int, int] = {}
-        edge_of_channel: typing.Dict[int, typing.List[int]] = {}  # t.id -> per-channel edge idx
-        for t in order:
+        edge_of_channel: typing.Dict[int, typing.List[int]] = {}  # head id -> per-channel edge idx
+        for t in heads:
             base = 0
             channel_edges: typing.List[int] = []
             for edge_idx, edge in enumerate(t.inputs):
@@ -352,38 +509,48 @@ class LocalExecutor:
             gate_size[t.id] = base
             edge_of_channel[t.id] = channel_edges
 
-        # Pass 2: instantiate subtasks and gates.  A distributed executor
-        # owns only the subtasks placed on this process (_owns_subtask);
-        # the identical graph is built on every process, so channel
-        # layout and subtask indices agree cluster-wide.
-        for t in order:
+        # Pass 2: instantiate one subtask per chain per parallel index.
+        # A distributed executor owns only the subtasks placed on this
+        # process (_owns_subtask); the identical graph AND chain plan are
+        # built on every process, so channel layout and subtask indices
+        # agree cluster-wide.  Chain members share their head's index —
+        # chaining requires equal parallelism, so placement is identical.
+        for t in heads:
+            chain = chain_by_head[t.id]
             subtasks = []
             for i in range(t.parallelism):
                 if not self._owns_subtask(t, i):
                     continue
-                operator = t.operator_factory()
+                operators = [member.operator_factory() for member in chain]
                 gate = None
                 if not t.is_source:
                     gate = InputGate(gate_size[t.id], capacity=self.channel_capacity)
                     gates[(t.id, i)] = gate
                     self._gates.append(gate)
-                st = _Subtask(self, t, i, operator, gate, gate_size[t.id],
+                st = _Subtask(self, chain, i, operators, gate, gate_size[t.id],
                               edge_of_channel[t.id])
                 subtasks.append(st)
-            by_transformation[t.id] = subtasks
+            by_head[t.id] = subtasks
 
-        # Pass 3: wire outputs.
-        for t in order:
+        # Pass 3: wire outputs.  Only the chain TAIL talks to channels —
+        # every cross-chain edge targets another chain's head gate (a
+        # non-head member's sole input is its fused edge).  Within the
+        # chain, each operator's output is a ChainedOutput invoking the
+        # next member directly.
+        for t in heads:
+            chain = chain_by_head[t.id]
+            tail = chain[-1]
             downstream = [
                 (d, edge_idx, edge)
                 for d in self.graph.transformations
                 for edge_idx, edge in enumerate(d.inputs)
-                if edge.upstream.id == t.id
+                if edge.upstream.id == tail.id
             ]
-            for st in by_transformation[t.id]:
+            for st in by_head[t.id]:
                 edges_for_output = []
                 for d, edge_idx, edge in downstream:
-                    base = channel_base[(d.id, edge_idx)]
+                    head_d = plan.head_of[d.id]
+                    base = channel_base[(head_d.id, edge_idx)]
                     if isinstance(edge.partitioner, ForwardPartitioner):
                         targets = [(st.index, base)]
                     else:
@@ -393,8 +560,8 @@ class LocalExecutor:
                     # the record plane (records AND barriers flow through
                     # it — alignment spans processes).
                     writers = [
-                        ChannelWriter(gates[(d.id, j)], ch)
-                        if (d.id, j) in gates
+                        ChannelWriter(gates[(head_d.id, j)], ch)
+                        if (head_d.id, j) in gates
                         else self._remote_writer(d, j, ch)
                         for j, ch in targets
                     ]
@@ -403,65 +570,120 @@ class LocalExecutor:
                     import copy
 
                     edges_for_output.append((copy.deepcopy(edge.partitioner), writers))
-                grp = self.metrics.group(st.scope)
-                st.output = Output(edges_for_output,
-                                   meter=grp.meter("records_out"),
-                                   stats=st.stats)
-                st.records_in = grp.meter("records_in")
-                st.latency = grp.timer("process_latency_s")
+
+                # Tail gets the real channel Output; every earlier member
+                # gets a ChainedOutput onto its successor.
+                tail_unit = st.units[-1]
+                tail_grp = self.metrics.group(tail_unit.scope)
+                tail_unit.output = Output(edges_for_output,
+                                          meter=tail_grp.meter("records_out"),
+                                          stats=st.stats)
+                for k in range(len(st.units) - 2, -1, -1):
+                    unit = st.units[k]
+                    nxt = st.units[k + 1]
+                    grp_k = self.metrics.group(unit.scope)
+                    unit.output = ChainedOutput(
+                        st, nxt, grp_k.meter("records_out"))
+
+                self._wire_units(st, gates)
+        # Register per-edge record-plane gauges after wiring (the gate
+        # and channel layout are both final here).
+        for t in heads:
+            for st in by_head[t.id]:
+                self._register_edge_gauges(st, t, channel_base)
+
+    def _wire_units(self, st: _Subtask, gates) -> None:
+        """Per-unit instrumentation + RuntimeContext + operator setup."""
+        proc_idx, num_procs = self._process_identity()
+        head_gate = st.gate
+        chain_len = len(st.units)
+        for pos, unit in enumerate(st.units):
+            grp = self.metrics.group(unit.scope)
+            unit.records_in = grp.meter("records_in")
+            unit.latency = grp.timer("process_latency_s")
+            # Chain-shape gauges: what got fused where (the inspector's
+            # chain column and the CI no-queue-traffic guard read these).
+            grp.gauge("chain_length", lambda n=chain_len: n)
+            grp.gauge("chained_edges", lambda n=chain_len - 1: n)
+            grp.gauge("chain_position", lambda p=pos: p)
+            if pos == 0:
+                st.records_in = unit.records_in
+                st.latency = unit.latency
                 st.alignment = grp.timer("checkpoint_alignment_s")
                 # Pull-based gauges: the hot path only bumps the plain
-                # accumulators above; evaluation happens at report time.
+                # accumulators; evaluation happens at report time.
                 stats = st.stats
-                latency = st.latency
+                latency = unit.latency
                 grp.gauge("idle_s", lambda s=stats: s.idle_s)
                 grp.gauge("busy_s", lambda tm=latency: tm.total_s)
                 grp.gauge("backpressure_s", lambda s=stats: s.blocked_s)
-                gate_for_metrics = st.gate
-                if gate_for_metrics is not None:
+                if head_gate is not None:
                     grp.gauge("queue_depth",
-                              lambda g=gate_for_metrics: g.depth)
+                              lambda g=head_gate: g.depth)
                     grp.gauge("queue_high_watermark",
-                              lambda g=gate_for_metrics: g.high_watermark)
+                              lambda g=head_gate: g.high_watermark)
                     # Time UPSTREAM writers spent blocked putting into
                     # this subtask's gate — "this operator causes the
                     # backpressure above it".
                     grp.gauge("in_backpressure_s",
-                              lambda g=gate_for_metrics: g.blocked_put_s)
-                state = KeyedStateStore()
-                device = (
-                    self.device_provider(t.name, st.index) if self.device_provider else None
+                              lambda g=head_gate: g.blocked_put_s)
+            state = KeyedStateStore()
+            device = (
+                self.device_provider(unit.t.name, unit.index)
+                if self.device_provider else None
+            )
+            if device is not None:
+                from flink_tensorflow_tpu.utils.profiling import (
+                    device_memory_stats,
                 )
-                if device is not None:
-                    from flink_tensorflow_tpu.utils.profiling import (
-                        device_memory_stats,
-                    )
 
-                    grp.gauge(
-                        "hbm_bytes_in_use",
-                        lambda d=device: device_memory_stats(d).get("bytes_in_use"),
-                    )
-                proc_idx, num_procs = self._process_identity()
-                ctx = RuntimeContext(
-                    task_name=t.name,
-                    subtask_index=st.index,
-                    parallelism=t.parallelism,
-                    keyed_state=state,
-                    metric_group=self.metrics.group(st.scope),
-                    device=device,
-                    mesh=self.mesh,
-                    job_config=self.job_config,
-                    process_index=proc_idx,
-                    num_processes=num_procs,
+                grp.gauge(
+                    "hbm_bytes_in_use",
+                    lambda d=device: device_memory_stats(d).get("bytes_in_use"),
                 )
-                gate = getattr(st, "gate", None)
-                if gate is not None:
-                    # Operator-owned background threads (the model
-                    # runner's fetch thread) use this to break the
-                    # subtask loop's poll sleep when results complete.
-                    ctx.wakeup = gate.wake
-                st.operator.setup(ctx, st.output, state)
-                self.subtasks.append(st)
+            ctx = RuntimeContext(
+                task_name=unit.t.name,
+                subtask_index=unit.index,
+                parallelism=unit.t.parallelism,
+                keyed_state=state,
+                metric_group=grp,
+                device=device,
+                mesh=self.mesh,
+                job_config=self.job_config,
+                process_index=proc_idx,
+                num_processes=num_procs,
+            )
+            if head_gate is not None:
+                # Operator-owned background threads (the model runner's
+                # fetch thread) use this to break the CHAIN's event wait
+                # when results complete — every fused member wakes the
+                # one thread that runs it.
+                ctx.wakeup = head_gate.wake
+            unit.operator.setup(ctx, unit.output, state)
+        self.subtasks.append(st)
+
+    def _register_edge_gauges(self, st: _Subtask, head: Transformation,
+                              channel_base) -> None:
+        """Per-EDGE queue gauges on the record plane: cumulative puts and
+        current buffered depth for each input edge of the chain head,
+        summed over the edge's channel range.  A chained edge has no
+        gate, so its absence from the report IS the zero-queue-traffic
+        evidence the latency-floor CI guard asserts."""
+        gate = st.gate
+        if gate is None:
+            return
+        grp = self.metrics.group(st.scope)
+        for edge_idx, edge in enumerate(head.inputs):
+            lo = channel_base[(head.id, edge_idx)]
+            span = (1 if isinstance(edge.partitioner, ForwardPartitioner)
+                    else edge.upstream.parallelism)
+            hi = lo + span
+            name = f"edge{edge_idx}_{edge.upstream.name}"
+            grp.gauge(f"{name}_queue_puts",
+                      lambda g=gate, a=lo, b=hi: sum(g.puts_per_channel[a:b]))
+            grp.gauge(f"{name}_queue_depth",
+                      lambda g=gate, a=lo, b=hi: sum(
+                          max(0, c) for c in g.buffered_per_channel[a:b]))
 
     # --- placement hooks (overridden by DistributedExecutor) -------------
     def _owns_subtask(self, t: Transformation, index: int) -> bool:
@@ -505,31 +727,36 @@ class LocalExecutor:
                     "routing would change and orphan keyed state. Restore "
                     "with the original max_parallelism."
                 )
-        by_task: typing.Dict[str, typing.List[_Subtask]] = {}
+        # Restore addresses LOGICAL operators — checkpoints key state by
+        # (task name, subtask index), so a job re-planned with a
+        # different chaining layout (chaining toggled, escape hatches
+        # added) still restores every operator's state correctly.
+        by_task: typing.Dict[str, typing.List[_ChainedUnit]] = {}
         for st in self.subtasks:
-            by_task.setdefault(st.t.name, []).append(st)
-        for task, sts in by_task.items():
+            for unit in st.units:
+                by_task.setdefault(unit.t.name, []).append(unit)
+        for task, units in by_task.items():
             task_snaps = snapshots.get(task)
             if task_snaps is None:
                 continue
             old_parallelism = len(task_snaps)
             # The NEW parallelism is the transformation's declared one —
-            # on a distributed executor the local subtask list is only
+            # on a distributed executor the local unit list is only
             # this process's share of it.
-            new_parallelism = sts[0].t.parallelism
+            new_parallelism = units[0].t.parallelism
             if local_shard or old_parallelism == new_parallelism:
-                for st in sts:
-                    snap = task_snaps.get(st.index)
+                for unit in units:
+                    snap = task_snaps.get(unit.index)
                     if snap is not None:
-                        st.operator.restore(snap)
+                        unit.operator.restore(snap)
             else:
                 # Parallelism changed across the restart: redistribute by
                 # key group (Flink's rescaling semantics; keyed state only
                 # — per-subtask state raises StateNotRescalable).
-                for st in sts:
-                    st.operator.restore(
-                        st.operator.rescale(
-                            task_snaps, st.index, new_parallelism,
+                for unit in units:
+                    unit.operator.restore(
+                        unit.operator.rescale(
+                            task_snaps, unit.index, new_parallelism,
                             self.max_parallelism,
                         )
                     )
@@ -626,7 +853,7 @@ class LocalExecutor:
 
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
         """Fan a durable-checkpoint notification out to every subtask
-        (delivered on each subtask's own thread)."""
+        (delivered to each chained operator on the subtask's own thread)."""
         for st in self.subtasks:
             st.add_notification(checkpoint_id)
 
@@ -639,4 +866,7 @@ class LocalExecutor:
 
     @property
     def total_subtasks(self) -> int:
-        return len(self.subtasks)
+        """LOGICAL subtask count (one per operator per parallel index) —
+        the checkpoint coordinator expects one ack per logical operator
+        regardless of how chains pack them onto threads."""
+        return sum(len(st.units) for st in self.subtasks)

@@ -187,6 +187,24 @@ class DataStream:
                          schema_fn=_schema_fn(output_schema, f))
         return DataStream(self.env, t)
 
+    # -- operator chaining ------------------------------------------------
+    def start_new_chain(self) -> "DataStream":
+        """Pin this operator as the head of a new chain: the runtime will
+        not fuse it with its upstream, even when the edge is a chainable
+        forward hop (Flink's ``startNewChain``).  Chaining with its
+        DOWNSTREAM operators stays allowed."""
+        self.transformation.chain_start = True
+        return self
+
+    def disable_chaining(self) -> "DataStream":
+        """Keep this operator out of operator chains entirely — it runs
+        on its own subtask thread with real channels on both sides
+        (Flink's ``disableChaining``).  Use for operators that must not
+        share a thread with their neighbors (blocking I/O, GIL-heavy
+        host work that would serialize a fused pipeline)."""
+        self.transformation.chainable = False
+        return self
+
     # -- partitioning -----------------------------------------------------
     def key_by(self, key_selector: typing.Callable[[typing.Any], typing.Any]) -> "KeyedStream":
         return KeyedStream(self.env, self.transformation, key_selector)

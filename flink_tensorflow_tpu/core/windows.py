@@ -49,6 +49,13 @@ class Trigger:
         """Processing-time deadline at which the window must flush, or None."""
         return None
 
+    def has_deadlines(self) -> bool:
+        """Whether this trigger can EVER declare a wall-clock deadline —
+        purely-arrival-driven triggers (count, sliding count) inherit the
+        base ``deadline`` and return False, which lets the chaining pass
+        fuse their windows into source chains (analysis/chaining.py)."""
+        return type(self).deadline is not Trigger.deadline
+
     def clone(self) -> "Trigger":
         """Per-subtask copy.  Stateless triggers (the default) are shared;
         triggers carrying mutable estimator state override this so

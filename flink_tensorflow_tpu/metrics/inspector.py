@@ -140,6 +140,9 @@ def inspect_pipeline(
         prometheus_path=prometheus_path,
     )
     env.configure(metrics=metrics_cfg)
+    from flink_tensorflow_tpu.analysis.chaining import compute_chains
+
+    plan = compute_chains(env.graph, enabled=env.config.chaining)
     t0 = time.monotonic()
     env.execute("inspect", timeout=timeout_s)
     wall_s = time.monotonic() - t0
@@ -148,6 +151,11 @@ def inspect_pipeline(
     return {
         "pipeline": path,
         "wall_s": wall_s,
+        # The execution chain topology (analysis/chaining.py): which
+        # operators share a subtask thread — fused members pass records
+        # by direct call and show no queue gauges at all.
+        "chains": plan.names(),
+        "chained_edges": plan.chained_edge_count,
         "subtasks": build_rows(tree, wall_s),
         "job": job_level,
     }
@@ -194,7 +202,11 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
             exit_code = max(exit_code, 2)
             continue
         if not args.snapshot_only:
-            print(f"== {path} ({snap['wall_s']:.2f}s wall) ==")
+            print(f"== {path} ({snap['wall_s']:.2f}s wall, "
+                  f"{len(snap['chains'])} chain(s), "
+                  f"{snap['chained_edges']} fused edge(s)) ==")
+            for members in snap["chains"]:
+                print("chain: " + " -> ".join(members))
             print(format_table(snap["subtasks"]))
         from flink_tensorflow_tpu.metrics.reporters import json_safe
 

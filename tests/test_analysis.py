@@ -520,3 +520,83 @@ class TestWatermarkLints:
         # Only the assigner actually feeding the map counts; the fine one
         # is shadowed by the coarse re-timing below it.
         assert diags == []
+
+
+class _AnnotatedMap(fn.MapFunction):
+    """Non-gang map declaring batch-dim sharding axes + a fixed batch."""
+
+    def __init__(self, axes, batch=None):
+        self.sharding_axes = axes
+        self._policy = BucketPolicy(fixed_batch=batch) if batch else None
+
+    def map(self, value):
+        return value
+
+
+class TestShardingAxisLint:
+    """ROADMAP's deferred sharding-axis lint: NamedSharding / batch-dim
+    annotations validated against the mesh axes at plan time, sharing
+    its annotation vocabulary with the operator-chaining pass."""
+
+    def test_unknown_axis_is_error(self):
+        env = StreamExecutionEnvironment()
+        env.set_mesh(_FakeMesh({"data": 4}))
+        (env.from_collection([1, 2, 3])
+            .map(_AnnotatedMap(("model",)), name="tp")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph, config=env.config), "sharding-axis")
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        assert len(errors) == 1
+        assert "model" in errors[0].message and errors[0].node == "tp"
+
+    def test_annotation_without_mesh_is_error(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1, 2, 3])
+            .map(_AnnotatedMap(("data",)), name="dp")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph, config=env.config), "sharding-axis")
+        assert any("no mesh" in d.message for d in diags)
+        # Without a config the rule cannot know the mesh and stays quiet.
+        assert by_rule(analyze(env.graph), "sharding-axis") == []
+
+    def test_ragged_batch_over_declared_axes_is_error(self):
+        env = StreamExecutionEnvironment()
+        env.set_mesh(_FakeMesh({"data": 4}))
+        (env.from_collection([1, 2, 3])
+            .map(_AnnotatedMap(("data",), batch=6), name="ragged")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph, config=env.config), "sharding-axis")
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        assert len(errors) == 1 and "does not divide" in errors[0].message
+
+    def test_valid_annotation_is_clean(self):
+        env = StreamExecutionEnvironment()
+        env.set_mesh(_FakeMesh({"data": 4}))
+        (env.from_collection([1, 2, 3])
+            .map(_AnnotatedMap(("data",), batch=8), name="ok")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph, config=env.config), "sharding-axis")
+        assert [d for d in diags if d.severity == Severity.ERROR] == []
+
+    def test_gang_mesh_errors_not_duplicated(self):
+        """Gang missing-mesh / data-divisibility stay mesh-divisibility's
+        findings; sharding-axis adds only the axis-existence check."""
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1, 2, 3], schema=SCHEMA_F32)
+            .count_window(4)
+            .apply(_StubGangFn(global_batch=4), name="gang"))
+        diags = analyze(env.graph, config=env.config)
+        assert by_rule(diags, "sharding-axis") == []
+        assert any(d.rule == "mesh-divisibility" for d in diags)
+
+    def test_mismatched_forward_edge_is_warned(self):
+        env = StreamExecutionEnvironment()
+        env.set_mesh(_FakeMesh({"data": 2, "model": 2}))
+        (env.from_collection([1, 2, 3])
+            .map(_AnnotatedMap(("data",)), name="up")
+            .map(_AnnotatedMap(("model",)), name="down")
+            .sink_to_list())
+        diags = by_rule(analyze(env.graph, config=env.config), "sharding-axis")
+        warns = [d for d in diags if d.severity == Severity.WARN]
+        assert any("will not chain" in d.message
+                   and d.edge == edge_name("up", "down") for d in warns)

@@ -384,8 +384,13 @@ class TestRuntimeInstrumentation:
 
         env = StreamExecutionEnvironment()
         env.enable_checkpointing(str(tmp_path / "chk"), every_n_records=16)
+        # disable_chaining keeps the map a real worker with an input
+        # gate: a chained operator never aligns (barriers traverse the
+        # chain by direct call), so this scope would have no alignment
+        # spans at all.
         (env.from_source(CollectionSource(list(range(64))), name="src")
             .map(lambda x: x, name="fwd")
+            .disable_chaining()
             .sink_to_list())
         env.execute("chk", timeout=120)
         chk = env.metric_registry.snapshot()["checkpoint"]
