@@ -26,11 +26,14 @@ An edge ``u -> d`` fuses only when ALL of these hold:
   annotation the ``sharding-axis`` lint (analysis/rules.py) validates
   against the mesh;
 - timer-driven operators (windows with wall-clock deadlines, async
-  maps, process functions) never fuse INTO a source chain: the source
-  loop blocks inside the user function's sleep/IO and cannot serve
-  wall-clock timers promptly.  Behind a worker head they fuse fine —
-  the worker loop waits event-driven until the chain's earliest
-  deadline.
+  maps, process functions) never fuse INTO a LEGACY source chain: the
+  ``SourceFunction.run()`` loop blocks inside the user function's
+  sleep/IO and cannot serve wall-clock timers promptly.  Behind a
+  worker head they fuse fine — the worker loop waits event-driven until
+  the chain's earliest deadline.  SPLIT-source heads
+  (``sources.SplitSourceOperator``, marked ``wakeable``) are exempt:
+  their mailbox loop bounds every wait by the chain's earliest
+  deadline, so timer-driven members fuse behind them too.
 """
 
 from __future__ import annotations
@@ -222,14 +225,20 @@ def compute_chains(
                 elif isinstance(e.partitioner, ForwardPartitioner):
                     reasons[(e.upstream.id, t.id)] = reason
 
-    # Source chains cannot serve wall-clock timers (the source loop
-    # blocks inside the user function's sleeps), so a source-headed
+    # LEGACY source chains cannot serve wall-clock timers (the source
+    # loop blocks inside the user function's sleeps), so a source-headed
     # chain is CUT before its first timer-driven member — transitively,
     # not just at the source's own edge: source -> map -> window(timeout)
     # must split at map|window, leaving the window a worker head whose
     # loop waits event-driven until the chain's earliest deadline.
+    # SPLIT sources (sources/, FLIP-27 model) are exempt: their loop
+    # owns all waiting on a wakeable mailbox bounded by the chain's
+    # earliest deadline, so timer-driven members fuse fine behind them.
     for t in order:
         if not t.is_source:
+            continue
+        head_op = operators.get(t.id)
+        if head_op is not None and getattr(head_op, "wakeable", False):
             continue
         prev, cur = t, next_of.get(t.id)
         while cur is not None:

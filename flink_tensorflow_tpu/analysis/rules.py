@@ -432,6 +432,36 @@ def _sharding_axis(ctx: AnalysisContext, emit: Emit) -> None:
                 )
 
 
+@rule("source-split-parallelism", Severity.WARN)
+def _source_split_parallelism(ctx: AnalysisContext, emit: Emit) -> None:
+    """A bounded split source declaring fewer splits than its reader
+    parallelism leaves subtasks that can never receive work: assignment
+    is pull-based (sources/coordinator.py), so a reader without a split
+    to pull idles for the whole job.  Uses the source's plan-time
+    ``plan_split_count`` hook — sources whose count needs IO return None
+    and are skipped."""
+    for t in ctx.order:
+        if not t.is_source:
+            continue
+        op = ctx.operators.get(t.id)
+        if not getattr(op, "is_split_source", False):
+            continue
+        source = getattr(op, "source", None)
+        if source is None or not getattr(source, "bounded", True):
+            continue
+        hook = getattr(source, "plan_split_count", None)
+        count = hook() if hook is not None else None
+        if count is not None and count < t.parallelism:
+            emit(
+                f"bounded split source declares {count} split(s) for "
+                f"parallelism {t.parallelism} — {t.parallelism - count} "
+                "subtask(s) will never be assigned work; add splits "
+                "(more files / smaller records_per_split / higher "
+                "num_splits) or lower the source parallelism",
+                node=t.name,
+            )
+
+
 @rule("recompile-churn", Severity.WARN)
 def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
     """Shape-signature churn at jit boundaries: several distinct schemas

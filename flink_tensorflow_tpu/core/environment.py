@@ -227,15 +227,35 @@ class StreamExecutionEnvironment:
                                 parallelism=parallelism, schema=schema)
 
     def from_source(
-        self, source: fn.SourceFunction, *, name="source", parallelism: int = 1,
+        self, source, *, name="source", parallelism: int = 1,
         schema=None,
     ) -> DataStream:
-        """``schema`` (a RecordSchema) declares the records this source
-        emits — plan-time only: the analyzer propagates it downstream and
-        validates operator contracts against it before execution."""
+        """``source`` is either a legacy :class:`SourceFunction` (fixed
+        per-subtask stride) or a :class:`~flink_tensorflow_tpu.sources.
+        SplitSource` (FLIP-27-style dynamic split assignment — hosted by
+        the mailbox-driven split-source loop).  ``schema`` (a
+        RecordSchema) declares the records this source emits — plan-time
+        only: the analyzer propagates it downstream and validates
+        operator contracts against it before execution; a SplitSource
+        may also declare its own ``schema`` attribute (the argument
+        wins)."""
+        from flink_tensorflow_tpu.sources.api import SplitSource
+
+        if isinstance(source, SplitSource):
+            from flink_tensorflow_tpu.sources.operator import SplitSourceOperator
+
+            factory = lambda: SplitSourceOperator(name, source)  # noqa: E731
+            schema = schema if schema is not None else source.schema
+        elif isinstance(source, fn.SourceFunction):
+            factory = lambda: SourceOperator(name, source)  # noqa: E731
+        else:
+            raise TypeError(
+                f"from_source expects a SourceFunction or SplitSource, "
+                f"got {type(source).__name__}"
+            )
         t = self.graph.add(
             name,
-            lambda: SourceOperator(name, source),
+            factory,
             parallelism,
             is_source=True,
             declared_schema=schema,

@@ -171,6 +171,10 @@ class _Subtask:
         self.edge_of_channel = edge_of_channel or [0] * num_input_channels
         self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
         self._control_lock = threading.Lock()
+        #: sources.mailbox.SourceMailbox for split-source subtasks (set
+        #: by _build) — the ONE wait point of run_split_source; barrier
+        #: requests and notifications posted here wake the loop.
+        self.mailbox = None
         #: Completed-and-durable checkpoint ids awaiting delivery to the
         #: operators on THEIR thread (single-writer contract; Flink mailbox).
         self._notifications: "typing.List[int]" = []
@@ -196,6 +200,8 @@ class _Subtask:
     def request_checkpoint(self, checkpoint_id: int) -> None:
         with self._control_lock:
             self.control.append(checkpoint_id)
+        if self.mailbox is not None:
+            self.mailbox.notify()
 
     def _drain_control(self) -> typing.List[int]:
         with self._control_lock:
@@ -205,6 +211,8 @@ class _Subtask:
     def add_notification(self, checkpoint_id: int) -> None:
         with self._control_lock:
             self._notifications.append(checkpoint_id)
+        if self.mailbox is not None:
+            self.mailbox.notify()
 
     def _deliver_notifications(self) -> None:
         with self._control_lock:
@@ -288,6 +296,92 @@ class _Subtask:
             self._close_chain()
         except BaseException as exc:  # noqa: BLE001
             self.executor.fail(self, exc)
+        finally:
+            self.finished.set()
+            self.executor.subtask_finished(self)
+
+    def _split_barrier(self, checkpoint_id: int) -> None:
+        """Cut this reader's stream at a barrier: register with the
+        split coordinator FIRST (freezing assignment and, for reader 0,
+        staging the consistent enumerator-pool snapshot), then snapshot
+        this subtask and push the barrier down the chain."""
+        op = typing.cast("typing.Any", self.operator)
+        op.on_barrier(checkpoint_id)
+        self._snapshot_and_ack(checkpoint_id)
+        self.output.broadcast_element(el.CheckpointBarrier(checkpoint_id))
+
+    def run_split_source(self) -> None:
+        """Mailbox event loop for a split-based source (FLIP-27 model).
+
+        Unlike ``run_source`` — which blocks wherever the user generator
+        blocks — this loop owns ALL waiting: every iteration serves
+        durable-checkpoint notifications, pending barriers, and chained
+        operators' due timers, then asks the operator for one
+        non-blocking step (emit a record / park until ``due`` / done).
+        Parking happens exclusively on the subtask MAILBOX, bounded by
+        the earliest of the next record's due time and the chain's
+        earliest operator deadline, and is woken early by barrier
+        requests, split availability, notifications, ``ctx.wakeup``, and
+        cancellation.  This wakeable wait is why the chaining pass lets
+        timer-driven operators fuse into split-source chains.
+        """
+        from flink_tensorflow_tpu.sources.operator import DONE, RECORD
+
+        op = typing.cast("typing.Any", self.operator)
+        executor = self.executor
+        stats = self.stats
+        try:
+            self._open_chain()
+            throttle = executor.source_throttle_s
+            every_n = executor.checkpoint_every_n
+            while not executor.cancelled.is_set():
+                self._deliver_notifications()
+                for cid in self._drain_control():
+                    self._split_barrier(cid)
+                now = time.monotonic()
+                deadline = self._chain_next_deadline()
+                if deadline is not None and now >= deadline:
+                    self._chain_fire_due(now)
+                    deadline = self._chain_next_deadline()
+                kind, payload = op.poll_next()
+                if kind == RECORD:
+                    t_emit = time.monotonic()
+                    self.output.emit(payload)
+                    op.record_emitted()
+                    self.latency.update(time.monotonic() - t_emit)
+                    # Count-based barriers at deterministic PER-SUBTASK
+                    # positions (CheckpointCoordinator's every_n mode).
+                    if every_n and op.offset % every_n == 0:
+                        cid = op.offset // every_n
+                        if executor.coordinator.begin_source_checkpoint(cid):
+                            self._split_barrier(cid)
+                    if throttle:
+                        time.sleep(throttle)
+                    continue
+                if kind == DONE:
+                    break
+                # WAIT: nothing to do until `payload` (a record's due
+                # time, or None = until an event) / the chain's earliest
+                # timer — park on the mailbox, charging idle time.
+                due = payload
+                now = time.monotonic()
+                timeout = None
+                for target in (due, deadline):
+                    if target is not None:
+                        t = max(0.0, target - now)
+                        timeout = t if timeout is None else min(timeout, t)
+                t0 = now
+                self.mailbox.wait(timeout)
+                stats.idle_s += time.monotonic() - t0
+            # Serve barrier requests that raced with the last records.
+            for cid in self._drain_control():
+                self._split_barrier(cid)
+            if not executor.cancelled.is_set():
+                op.finish()
+                self.output.broadcast_element(el.EndOfPartition())
+            self._close_chain()
+        except BaseException as exc:  # noqa: BLE001
+            executor.fail(self, exc)
         finally:
             self.finished.set()
             self.executor.subtask_finished(self)
@@ -434,6 +528,10 @@ class LocalExecutor:
         self._error_lock = threading.Lock()
         self.subtasks: typing.List[_Subtask] = []
         self._gates: typing.List[InputGate] = []
+        #: One split coordinator per split-source transformation (the
+        #: FLIP-27 enumerator host) — shared by that source's readers.
+        self._split_coordinators: typing.Dict[str, typing.Any] = {}
+        self._split_lock = threading.Lock()
         #: The chaining decision (analysis.chaining.ChainPlan) — the
         #: inspector/analysis CLIs print its topology.
         self.chain_plan = None
@@ -529,6 +627,10 @@ class LocalExecutor:
                     self._gates.append(gate)
                 st = _Subtask(self, chain, i, operators, gate, gate_size[t.id],
                               edge_of_channel[t.id])
+                if t.is_source and getattr(operators[0], "is_split_source", False):
+                    from flink_tensorflow_tpu.sources.mailbox import SourceMailbox
+
+                    st.mailbox = SourceMailbox()
                 subtasks.append(st)
             by_head[t.id] = subtasks
 
@@ -659,7 +761,18 @@ class LocalExecutor:
                 # when results complete — every fused member wakes the
                 # one thread that runs it.
                 ctx.wakeup = head_gate.wake
+            elif st.mailbox is not None:
+                # Split-source chains wait on the mailbox instead of a
+                # gate; the same completion wakeup applies to every
+                # fused member.
+                ctx.wakeup = st.mailbox.notify
             unit.operator.setup(ctx, unit.output, state)
+            if pos == 0 and st.mailbox is not None:
+                # Wire the reader to its source's coordinator before
+                # restore() runs (restored enumerator state flows
+                # through the operator into the coordinator).
+                coord = self.split_coordinator(unit.t, unit.operator.source)
+                unit.operator.attach(coord, unit.index, st.mailbox)
         self.subtasks.append(st)
 
     def _register_edge_gauges(self, st: _Subtask, head: Transformation,
@@ -684,6 +797,34 @@ class LocalExecutor:
             grp.gauge(f"{name}_queue_depth",
                       lambda g=gate, a=lo, b=hi: sum(
                           max(0, c) for c in g.buffered_per_channel[a:b]))
+
+    def split_coordinator(self, t: Transformation, source):
+        """The (lazily created) SplitCoordinator for split source ``t``.
+        ``source`` is the shared SplitSource instance (every subtask's
+        factory closes over the same one).
+
+        Per-process by construction: a distributed cohort spreading one
+        split source's subtasks over several processes would run one
+        enumerator per process and double-assign every split — refuse
+        rather than duplicate records.
+        """
+        with self._split_lock:
+            coord = self._split_coordinators.get(t.name)
+            if coord is None:
+                if not all(self._owns_subtask(t, i) for i in range(t.parallelism)):
+                    raise ValueError(
+                        f"split source {t.name!r}: subtasks are spread over a "
+                        "process cohort but the split enumerator is "
+                        "per-process — run split sources on a single process "
+                        "(or use a legacy SourceFunction for cohort jobs)"
+                    )
+                from flink_tensorflow_tpu.sources.coordinator import (
+                    SplitCoordinator,
+                )
+
+                coord = SplitCoordinator(source, t.parallelism)
+                self._split_coordinators[t.name] = coord
+            return coord
 
     # --- placement hooks (overridden by DistributedExecutor) -------------
     def _owns_subtask(self, t: Transformation, index: int) -> bool:
@@ -760,11 +901,25 @@ class LocalExecutor:
                             self.max_parallelism,
                         )
                     )
+        # Split sources: push restored split/pool state into the
+        # per-source coordinators NOW — before any reader thread runs —
+        # so the lazily built enumerator always sees it (in-flight
+        # splits resume at their offsets; pooled splits redistribute).
+        for st in self.subtasks:
+            for unit in st.units:
+                apply = getattr(unit.operator, "apply_restore", None)
+                if apply is not None:
+                    apply()
 
     # --- execution --------------------------------------------------------
     def start(self) -> None:
         for st in self.subtasks:
-            body = st.run_source if st.t.is_source else st.run_worker
+            if not st.t.is_source:
+                body = st.run_worker
+            elif st.mailbox is not None:
+                body = st.run_split_source
+            else:
+                body = st.run_source
             st.thread = threading.Thread(target=body, name=st.scope, daemon=True)
         for st in self.subtasks:
             st.thread.start()
@@ -849,6 +1004,9 @@ class LocalExecutor:
         self.cancelled.set()
         for gate in self._gates:
             gate.close()
+        for st in self.subtasks:
+            if st.mailbox is not None:
+                st.mailbox.notify()
         self.coordinator.cancel_pending()
 
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:

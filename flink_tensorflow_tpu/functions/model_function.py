@@ -40,6 +40,10 @@ from flink_tensorflow_tpu.tensors.value import TensorValue
 
 ModelSource = typing.Union[Model, str, SavedModelLoader, typing.Callable[[], Model]]
 
+#: Sentinel: output-schema derivation not attempted yet (None is a
+#: legitimate cached answer — "tried, unknowable").
+_UNKNOWN = object()
+
 
 def _resolve(source: ModelSource) -> Model:
     if isinstance(source, Model):
@@ -84,6 +88,7 @@ class _ModelFunctionBase(fn.RichFunction):
         self._stamp_stages = stamp_stages
         self.runner: typing.Optional[CompiledMethodRunner] = None
         self._out: typing.Optional[fn.Collector] = None
+        self._derived_schema: typing.Any = _UNKNOWN
 
     # -- plan-time hooks (no model load, no device work) ------------------
     def plan_input_schema(self):
@@ -101,16 +106,66 @@ class _ModelFunctionBase(fn.RichFunction):
 
     def output_schema(self, input_schema):
         """Plan-analyzer hook: validate the incoming record schema
-        against the model method's declared inputs.  Output shapes are
-        not knowable without compiling, so propagation stops here
-        (returns None)."""
+        against the model method's declared inputs, then DERIVE the
+        output schema abstractly via ``jax.eval_shape`` over the input
+        schema's batched struct — shape propagation without compiling or
+        touching a device (the same AOT posture as the rest of the
+        analyzer).  Lazy model sources (bundle paths, loaders) and
+        methods whose tracing fails stay unknown (None) rather than
+        failing the plan."""
         from flink_tensorflow_tpu.tensors.schema import check_compatible
 
         expected = self.plan_input_schema()
         if expected is not None and input_schema is not None:
             check_compatible(expected, input_schema,
                              where=f"model method {self._method_name!r}")
-        return None
+        return self._derive_output_schema()
+
+    def _derive_output_schema(self):
+        """Output RecordSchema via ``jax.eval_shape`` (cached), or None.
+
+        Only for resolved Models (lazy sources would pay a load at plan
+        time) whose method takes no per-record lengths — the lengths
+        side input has no schema slot to trace from.  Dynamic input dims
+        trace at the warmup length bucket: bucketing pins them before
+        anything reaches XLA, so the bucketed trace IS the runtime
+        shape contract (dims the method carries through un-reduced stay
+        that bucket size in the derived schema).
+        """
+        if self._derived_schema is not _UNKNOWN:
+            return self._derived_schema
+        self._derived_schema = None
+        expected = self.plan_input_schema()
+        if expected is None or not isinstance(self._source, Model):
+            return None
+        try:
+            method = self._source.method(self._method_name)
+            if method.needs_lengths:
+                return None
+            import jax
+            import numpy as np
+
+            from flink_tensorflow_tpu.tensors.schema import RecordSchema, TensorSpec
+
+            shapes = expected.resolve_dynamic(self._warmup_length_bucket)
+            struct = {
+                name: jax.ShapeDtypeStruct((1, *shapes[name]), spec.dtype)
+                for name, spec in expected.fields.items()
+            }
+            params = self._source.params
+            outputs = jax.eval_shape(lambda x: method.fn(params, x), struct)
+            names = self._outputs or method.output_names or sorted(outputs)
+            fields = {}
+            for name in names:
+                out = outputs[name]
+                if not out.shape or out.shape[0] != 1:
+                    return None  # not batch-major: no per-record schema
+                fields[name] = TensorSpec(tuple(out.shape[1:]),
+                                          np.dtype(out.dtype))
+            self._derived_schema = RecordSchema(fields)
+        except Exception:  # noqa: BLE001 - plan-time best effort, never fatal
+            self._derived_schema = None
+        return self._derived_schema
 
     def plan_policy(self):
         """The bucket policy the runner will resolve at open()."""

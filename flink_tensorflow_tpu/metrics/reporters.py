@@ -146,6 +146,57 @@ class PrometheusFileReporter(MetricReporter):
         os.replace(tmp, self.path)
 
 
+class PrometheusHttpReporter(MetricReporter):
+    """Live HTTP scrape endpoint: a stdlib ``http.server`` daemon thread
+    serving the latest text exposition (the same format the atomic-file
+    reporter writes) at every path — point a Prometheus scrape job at
+    ``http://host:port/metrics`` with no textfile collector in between.
+
+    ``port=0`` binds an ephemeral port; read the resolved one from
+    ``.port``.  The handler serves a cached string swapped atomically by
+    :meth:`report` (plain attribute assignment — a scrape sees either
+    the previous exposition or the new one, never a torn mix), so scrape
+    traffic costs the job nothing beyond the interval's render.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+
+        reporter = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib handler contract
+                body = reporter._text.encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        self._text = "# flink-tensorflow-tpu metrics: no report yet\n"
+        self._server = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="prometheus-http",
+            daemon=True)
+        self._thread.start()
+
+    def report(self, snapshot: Snapshot, *, timestamp: float) -> None:
+        self._text = prometheus_exposition(snapshot, timestamp)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
 class ConsoleReporter(MetricReporter):
     """Human-oriented: one compact line per scope per report."""
 
@@ -190,6 +241,9 @@ class MetricConfig:
     jsonl_path: typing.Optional[str] = None
     #: Maintain a Prometheus text-exposition file at this path.
     prometheus_path: typing.Optional[str] = None
+    #: Serve the exposition over HTTP on this port (0 = ephemeral; the
+    #: resolved port is on the reporter instance).  None = no server.
+    http_port: typing.Optional[int] = None
     #: Print per-scope lines to stderr each interval.
     console: bool = False
     #: Extra user-constructed :class:`MetricReporter` instances.
@@ -202,6 +256,10 @@ class MetricConfig:
         if self.report_interval_s is not None and self.report_interval_s <= 0:
             raise ValueError(
                 f"metrics.report_interval_s must be > 0, got {self.report_interval_s}"
+            )
+        if self.http_port is not None and not (0 <= self.http_port <= 65535):
+            raise ValueError(
+                f"metrics.http_port must be a port number, got {self.http_port}"
             )
         for r in self.reporters:
             if not isinstance(r, MetricReporter):
@@ -216,6 +274,8 @@ class MetricConfig:
             sinks.append(JsonLinesReporter(self.jsonl_path))
         if self.prometheus_path is not None:
             sinks.append(PrometheusFileReporter(self.prometheus_path))
+        if self.http_port is not None:
+            sinks.append(PrometheusHttpReporter(self.http_port))
         if self.console:
             sinks.append(ConsoleReporter())
         return sinks

@@ -1,0 +1,44 @@
+"""Split-based sources (FLIP-27-style): SplitEnumerator + SourceReader
++ pull-based dynamic split assignment + a wakeable mailbox source loop.
+
+See sources/api.py for the protocol contract and README "Split-based
+sources" for the migration story from ``SourceFunction``.
+"""
+
+from flink_tensorflow_tpu.sources.api import (
+    ListSplitEnumerator,
+    NotReady,
+    SourceReader,
+    SourceSplit,
+    SplitEnumerator,
+    SplitSource,
+)
+from flink_tensorflow_tpu.sources.coordinator import SplitCoordinator
+from flink_tensorflow_tpu.sources.file_source import FileSplit, FileSplitSource
+from flink_tensorflow_tpu.sources.mailbox import SourceMailbox
+from flink_tensorflow_tpu.sources.operator import SplitSourceOperator
+from flink_tensorflow_tpu.sources.paced import PacedSplit, PacedSplitSource
+from flink_tensorflow_tpu.sources.replay import (
+    RangeSplit,
+    ReplaySplitSource,
+    range_splits,
+)
+
+__all__ = [
+    "FileSplit",
+    "FileSplitSource",
+    "ListSplitEnumerator",
+    "NotReady",
+    "PacedSplit",
+    "PacedSplitSource",
+    "RangeSplit",
+    "ReplaySplitSource",
+    "SourceMailbox",
+    "SourceReader",
+    "SourceSplit",
+    "SplitCoordinator",
+    "SplitEnumerator",
+    "SplitSource",
+    "SplitSourceOperator",
+    "range_splits",
+]

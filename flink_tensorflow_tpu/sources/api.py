@@ -1,0 +1,170 @@
+"""Split-based source API — the FLIP-27-style source protocols.
+
+The legacy ``SourceFunction.run()`` generator model (io/sources.py)
+freezes work distribution at plan time: subtask i owns records
+``i, i+N, ...`` forever, a failed subtask can only replay its fixed
+stride, and the source loop blocks inside user-code sleeps where no
+wall-clock timer can reach it.  Flink's answer (FLIP-27, Carbone et al.)
+splits a source into three roles, mirrored here:
+
+- :class:`SourceSplit` — one unit of assignable work (a file range, a
+  slice of a sequence) carrying its own replay ``offset``;
+- :class:`SplitEnumerator` — the per-job split pool.  Assignment is
+  PULL-based: an idle reader asks for the next split, so a fast subtask
+  naturally steals work a slow one never got to (elasticity without a
+  rebalancing pass);
+- :class:`SourceReader` — turns one split into records on a subtask.
+
+A :class:`SplitSource` bundles the three factories and is what
+``env.from_source(...)`` accepts; the runtime hosts it in a
+``SplitSourceOperator`` whose mailbox event loop (core/runtime.py)
+multiplexes record fetch, split assignment, checkpoint barriers, and
+chained-operator timer deadlines on one condition variable — the
+wakeable wait that lets timer-driven operators fuse into source chains
+(analysis/chaining.py).
+
+Exactly-once contract: a reader's in-flight split (with its record
+offset) snapshots into the reader's own checkpoint state; the
+enumerator's unassigned pool snapshots alongside it through the
+coordinator (sources/coordinator.py), with assignment frozen while a
+barrier aligns across the source's readers so no split can be both
+"pending" in the enumerator snapshot and "emitted" before a reader's
+barrier.  Restored splits resume at their recorded offsets; splits of
+lost readers rejoin the pool and redistribute.
+"""
+
+from __future__ import annotations
+
+import abc
+import copy
+import dataclasses
+import typing
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.core.runtime_context import RuntimeContext
+    from flink_tensorflow_tpu.tensors.schema import RecordSchema
+
+
+@dataclasses.dataclass
+class SourceSplit:
+    """One assignable unit of source work.
+
+    ``offset`` counts the records this split has already emitted
+    downstream — the reader skips that many on (re)start, which is what
+    makes a restored split resume mid-way instead of replaying from its
+    first record.  Concrete splits subclass with their addressing fields
+    (file path + record range, sequence range, ...).
+    """
+
+    split_id: str
+    offset: int = 0
+
+    def freeze(self) -> "SourceSplit":
+        """Immutable-as-of-now copy for snapshots: the live split keeps
+        advancing ``offset`` on the reader thread while the checkpoint
+        store serializes asynchronously — snapshotting the live object
+        would race the write with post-barrier progress."""
+        return copy.copy(self)
+
+
+@dataclasses.dataclass
+class NotReady:
+    """Yielded by a reader iterator when its next record is not due yet
+    (paced/open-loop sources).  ``due`` is the monotonic time the record
+    becomes ready; the source loop parks on its MAILBOX until then —
+    wakeable by barriers, notifications, and chained-operator timers —
+    instead of sleeping inside user code."""
+
+    due: float
+
+
+class SplitEnumerator(abc.ABC):
+    """Per-job split pool; runs under the coordinator's lock, so
+    implementations need no synchronization of their own."""
+
+    @abc.abstractmethod
+    def next_split(self, reader_index: int) -> typing.Optional[SourceSplit]:
+        """Next split for ``reader_index``, or None when the pool is
+        (currently) empty — for a bounded source that means done."""
+
+    @abc.abstractmethod
+    def add_splits_back(self, splits: typing.Sequence[SourceSplit]) -> None:
+        """Return splits to the pool (failover/rescale redistribution).
+        They keep their offsets, so reassignment resumes, not replays."""
+
+    @abc.abstractmethod
+    def snapshot_state(self) -> typing.Any:
+        """Picklable pool state.  Must be insulated from later mutation
+        of the live splits (copy them — see :meth:`SourceSplit.freeze`)
+        and must not be None: the restore path reads None as "nothing
+        was ever dispensed — start from the fresh split set"."""
+
+    @abc.abstractmethod
+    def restore_state(self, state: typing.Any) -> None: ...
+
+
+class ListSplitEnumerator(SplitEnumerator):
+    """The standard bounded enumerator: a FIFO pool over a fixed split
+    list.  Splits added back (failover) go to the FRONT so unfinished
+    work is re-dispatched before untouched splits."""
+
+    def __init__(self, splits: typing.Sequence[SourceSplit]):
+        self._pending: typing.List[SourceSplit] = list(splits)
+
+    def next_split(self, reader_index: int) -> typing.Optional[SourceSplit]:
+        return self._pending.pop(0) if self._pending else None
+
+    def add_splits_back(self, splits: typing.Sequence[SourceSplit]) -> None:
+        self._pending[:0] = list(splits)
+
+    def snapshot_state(self) -> typing.Any:
+        return [s.freeze() for s in self._pending]
+
+    def restore_state(self, state: typing.Any) -> None:
+        self._pending = [s.freeze() for s in state]
+
+
+class SourceReader(abc.ABC):
+    """Per-subtask record producer for assigned splits."""
+
+    def open(self, ctx: "RuntimeContext") -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+    @abc.abstractmethod
+    def read(self, split: SourceSplit) -> typing.Iterator[typing.Any]:
+        """Iterate the split's records STARTING at ``split.offset``
+        (already-emitted records are skipped, not re-yielded).  May yield
+        :class:`NotReady` markers when the next record is not due yet;
+        the runtime re-polls the iterator after the due time."""
+
+
+class SplitSource(abc.ABC):
+    """A split-based source: what ``env.from_source(...)`` accepts.
+
+    NOT a :class:`~flink_tensorflow_tpu.core.functions.SourceFunction` —
+    the environment detects this type and hosts it in the mailbox-driven
+    ``SplitSourceOperator`` instead of the legacy generator loop.
+    """
+
+    #: Bounded sources finish when the enumerator drains; unbounded ones
+    #: park on the mailbox and run until cancelled.
+    bounded: bool = True
+
+    #: Optional RecordSchema of emitted records (plan-time analyzer);
+    #: the ``schema=`` argument of ``from_source`` wins when given.
+    schema: typing.Optional["RecordSchema"] = None
+
+    @abc.abstractmethod
+    def create_enumerator(self) -> SplitEnumerator: ...
+
+    @abc.abstractmethod
+    def create_reader(self, ctx: "RuntimeContext") -> SourceReader: ...
+
+    def plan_split_count(self) -> typing.Optional[int]:
+        """Split count knowable WITHOUT IO at plan time, or None — the
+        ``source-split-parallelism`` lint compares it against the
+        source's parallelism (fewer splits than subtasks = idle readers)."""
+        return None

@@ -1,0 +1,181 @@
+"""Per-job split coordinator — the enumerator's thread-safe host.
+
+One coordinator exists per split-source transformation per executor
+(``LocalExecutor.split_coordinator``); all of that source's reader
+subtasks share it.  It owns the :class:`SplitEnumerator` behind a lock
+and implements the two protocols the runtime needs:
+
+**Pull-based assignment.**  ``poll_split(reader)`` hands out the next
+split on demand.  A reader that drains its split early simply asks
+again, so work steals itself: nobody plans a distribution, slow readers
+just pull less.  The call never blocks — it answers ``wait`` when
+assignment is momentarily impossible and the reader parks on its
+mailbox (sources/mailbox.py), to be woken when the state changes.
+
+**Checkpoint consistency.**  The enumerator's unassigned pool must be
+snapshotted CONSISTENTLY with every reader's own in-flight-split
+snapshot, or a split could restore both into the pool and into a
+reader (duplicate records), or into neither (lost records).  Protocol:
+the pool snapshot for checkpoint ``k`` is taken when the FIRST reader
+cuts its stream at barrier ``k``, and split assignment is FROZEN until
+every reader (or finished subtask) has passed ``k``.  With assignment
+frozen, a split is in exactly one place at every reader's barrier:
+unassigned (in the pool snapshot), in-flight on a reader (in that
+reader's snapshot, with offset), or completed (in neither — all its
+records pre-date every barrier).  Readers parked on the freeze still
+serve their own barriers (the mailbox wait is barrier-wakeable), so the
+freeze cannot deadlock the alignment it protects.
+
+The pool snapshot rides in reader 0's operator snapshot, so it lands in
+the existing checkpoint store under the source's own (task, subtask)
+identity — no new persistence format.
+"""
+
+from __future__ import annotations
+
+import threading
+import typing
+
+from flink_tensorflow_tpu.sources.api import SourceSplit, SplitEnumerator, SplitSource
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.sources.mailbox import SourceMailbox
+
+#: poll_split answers: a split to read, park-and-retry, or end of input.
+ASSIGNED = "assigned"
+WAIT = "wait"
+EXHAUSTED = "exhausted"
+
+
+class SplitCoordinator:
+    def __init__(self, source: SplitSource, num_readers: int):
+        self.source = source
+        self.num_readers = num_readers
+        self._lock = threading.Lock()
+        self._mailboxes: typing.Dict[int, "SourceMailbox"] = {}
+        self._enumerator: typing.Optional[SplitEnumerator] = None
+        #: Enumerator state delivered by restore() BEFORE the job starts
+        #: (reader 0's snapshot carries it); applied at lazy construction.
+        self._restored_state: typing.Any = None
+        self._has_restored_state = False
+        #: In-flight splits of LOST readers (rescale restore pools them
+        #: instead of pinning them to dead subtask indices).
+        self._returned: typing.List[SourceSplit] = []
+        #: checkpoint id -> reader indices that passed its barrier; any
+        #: entry here freezes assignment (see module docstring).
+        self._aligning: typing.Dict[int, typing.Set[int]] = {}
+        #: checkpoint id -> pool snapshot taken at its first barrier.
+        self._chk_state: typing.Dict[int, typing.Any] = {}
+        #: Readers whose subtask finished: they can no longer pass
+        #: barriers and must not hold alignments (or polls) open.
+        self._finished: typing.Set[int] = set()
+        #: Total splits handed out — the job-level assignment counter
+        #: behind the source's splits_assigned metrics.
+        self.splits_dispensed = 0
+
+    # -- wiring (executor build/restore time, before any thread runs) ----
+    def add_reader(self, index: int, mailbox: "SourceMailbox") -> None:
+        self._mailboxes[index] = mailbox
+
+    def deliver_restored_state(self, state: typing.Any) -> None:
+        with self._lock:
+            if self._enumerator is not None:
+                self._enumerator.restore_state(state)
+            else:
+                self._restored_state = state
+                self._has_restored_state = True
+
+    def add_splits_back(self, splits: typing.Sequence[SourceSplit]) -> None:
+        if not splits:
+            return
+        with self._lock:
+            if self._enumerator is not None:
+                self._enumerator.add_splits_back(list(splits))
+            else:
+                self._returned.extend(splits)
+        self._notify_all()
+
+    # -- assignment (reader threads) -------------------------------------
+    def _ensure_enumerator(self) -> SplitEnumerator:
+        """Build the enumerator on first use (caller holds the lock).
+        Restore state and returned splits were delivered before start()
+        (executor.restore runs before any subtask thread), so the lazy
+        build always sees them."""
+        if self._enumerator is None:
+            enum = self.source.create_enumerator()
+            if self._has_restored_state:
+                enum.restore_state(self._restored_state)
+                self._restored_state = None
+            if self._returned:
+                enum.add_splits_back(self._returned)
+                self._returned = []
+            self._enumerator = enum
+        return self._enumerator
+
+    def poll_split(
+        self, reader_index: int
+    ) -> typing.Tuple[str, typing.Optional[SourceSplit]]:
+        with self._lock:
+            if self._aligning:
+                # Assignment frozen mid-alignment; the barrier-complete
+                # path notifies every mailbox.
+                return WAIT, None
+            split = self._ensure_enumerator().next_split(reader_index)
+            if split is None:
+                return (EXHAUSTED if self.source.bounded else WAIT), None
+            self.splits_dispensed += 1
+            return ASSIGNED, split
+
+    # -- checkpoint protocol ---------------------------------------------
+    def on_barrier(self, checkpoint_id: int, reader_index: int) -> typing.Optional[typing.Any]:
+        """Reader ``reader_index`` is cutting its stream at this barrier.
+        Returns the pool snapshot for the checkpoint when THIS reader
+        carries it (reader 0 — the snapshot's persistence slot), else
+        None."""
+        with self._lock:
+            passed = self._aligning.get(checkpoint_id)
+            if passed is None:
+                passed = self._aligning[checkpoint_id] = set()
+                self._chk_state[checkpoint_id] = self._pool_state_locked()
+            passed.add(reader_index)
+            snap = self._chk_state[checkpoint_id] if reader_index == 0 else None
+            done = len(passed | self._finished) >= self.num_readers
+            if done:
+                del self._aligning[checkpoint_id]
+                self._chk_state.pop(checkpoint_id, None)
+        if done:
+            self._notify_all()
+        return snap
+
+    def reader_finished(self, reader_index: int) -> None:
+        """A reader's subtask ended (bounded input drained or failure
+        teardown): it counts as passed for every current and future
+        alignment — its final snapshot stands in for barrier acks
+        (mirroring CheckpointCoordinator._seed_finished)."""
+        with self._lock:
+            self._finished.add(reader_index)
+            complete = [
+                cid for cid, passed in self._aligning.items()
+                if len(passed | self._finished) >= self.num_readers
+            ]
+            for cid in complete:
+                del self._aligning[cid]
+                self._chk_state.pop(cid, None)
+        self._notify_all()
+
+    def live_pool_state(self) -> typing.Any:
+        """Current pool snapshot, outside any barrier — the job-end final
+        snapshot path (checkpoint races with completion)."""
+        with self._lock:
+            return self._pool_state_locked()
+
+    def _pool_state_locked(self) -> typing.Any:
+        if self._enumerator is not None:
+            return self._enumerator.snapshot_state()
+        # Nothing dispensed yet: the pool is whatever restore delivered
+        # (None = the source's fresh split set).
+        return self._restored_state if self._has_restored_state else None
+
+    def _notify_all(self) -> None:
+        for mailbox in self._mailboxes.values():
+            mailbox.notify()

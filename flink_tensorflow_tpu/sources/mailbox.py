@@ -1,0 +1,69 @@
+"""The wakeable source wait — one condition variable per source subtask.
+
+The legacy source loop (``_Subtask.run_source``) blocks wherever the
+user generator blocks: ``time.sleep`` inside a paced schedule, file IO,
+anything — checkpoint barrier requests and chained-operator timer
+deadlines wait until the generator happens to yield.  The mailbox
+inverts that: the split-source loop owns ALL waiting.  Whenever there is
+nothing to do right now (no split assigned, next record not due yet),
+the loop parks here with a deadline and is woken EARLY by whichever
+event arrives first:
+
+- a checkpoint barrier request (``_Subtask.request_checkpoint``),
+- a durable-checkpoint notification (``add_notification``),
+- a split becoming assignable again (coordinator unfreeze after barrier
+  alignment, splits added back on failover),
+- an operator-owned background thread completing work (``ctx.wakeup`` —
+  e.g. the model runner's fetch thread, for chained members),
+- job cancellation.
+
+This is the FLIP-27/FLINK-10653 mailbox model scoped to one subtask: a
+single thread, a single wait point, everything else posts events.  It is
+what makes the wait *wakeable*, which in turn lets the chaining pass
+fuse timer-driven operators into split-source chains — the loop simply
+bounds its park time by the chain's earliest deadline.
+"""
+
+from __future__ import annotations
+
+import threading
+import typing
+
+
+class SourceMailbox:
+    """Event signal for one split-source subtask thread.
+
+    Counting semantics (not a bare Event): a ``notify`` that lands while
+    the loop is processing — between waits — must not be lost, or a
+    barrier posted in that window would sit unserved until the next
+    unrelated wakeup.  ``wait`` consumes pending signals first and only
+    then parks.
+    """
+
+    __slots__ = ("_cond", "_signals")
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._signals = 0
+
+    def notify(self) -> None:
+        """Post an event: wake the parked loop (or mark the signal so the
+        next wait returns immediately).  Safe from any thread."""
+        with self._cond:
+            self._signals += 1
+            self._cond.notify()
+
+    def wait(self, timeout: typing.Optional[float]) -> bool:
+        """Park until a notify or ``timeout`` seconds (None = until
+        notified).  Returns True when woken by a signal, False on
+        timeout.  All pending signals are drained in one wait — the loop
+        re-examines every event source each iteration anyway."""
+        with self._cond:
+            if self._signals:
+                self._signals = 0
+                return True
+            if timeout is not None and timeout <= 0:
+                return False
+            notified = self._cond.wait(timeout)
+            self._signals = 0
+            return notified

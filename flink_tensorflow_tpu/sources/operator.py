@@ -1,0 +1,228 @@
+"""SplitSourceOperator — the runtime host of a SplitSource.
+
+The physical counterpart of ``env.from_source(split_source)``: one
+instance per source subtask, driven by the mailbox event loop in
+``core/runtime.py`` (``_Subtask.run_split_source``).  The operator owns
+the read-side state machine — current split, its record iterator, the
+per-split offset — and the loop owns all waiting; :meth:`poll_next`
+never blocks, it answers "here is a record", "park until ``due``", or
+"input exhausted".
+
+Checkpoint identity: the in-flight split (offset included) snapshots
+under this subtask's (task, index) key; reader 0 additionally carries
+the coordinator's unassigned-pool snapshot (taken consistently at the
+barrier — sources/coordinator.py).  ``offset`` mirrors the legacy
+SourceOperator's emitted-record counter so count-based barrier
+positions (``checkpoint.every_n_records``) keep working — note that
+with dynamic assignment those positions are NOT deterministic across
+runs, so multi-host cohorts should keep legacy sources for now.
+
+Unlike the legacy source, this operator RESCALES: on a restore with a
+different source parallelism, every old reader's in-flight split and
+the old pool redistribute through the coordinator — new readers pull
+from the merged pool and resume each split at its recorded offset.
+"""
+
+from __future__ import annotations
+
+import time
+import typing
+
+from flink_tensorflow_tpu.core.operators import Operator
+from flink_tensorflow_tpu.sources.api import NotReady, SourceSplit, SplitSource
+
+if typing.TYPE_CHECKING:
+    from flink_tensorflow_tpu.sources.coordinator import SplitCoordinator
+    from flink_tensorflow_tpu.sources.mailbox import SourceMailbox
+
+#: poll_next answers for the runtime loop.
+RECORD = "record"
+WAIT = "wait"
+DONE = "done"
+
+
+class SplitSourceOperator(Operator):
+    #: Read by the executor (thread-body selection) and the chaining
+    #: pass: this source's wait is mailbox-wakeable, so timer-driven
+    #: operators MAY fuse into its chain.
+    is_split_source = True
+    wakeable = True
+
+    def __init__(self, name: str, source: SplitSource):
+        super().__init__(name)
+        self.source = source
+        self.reader = None
+        self.coordinator: typing.Optional["SplitCoordinator"] = None
+        self.mailbox: typing.Optional["SourceMailbox"] = None
+        self.reader_index = 0
+        #: Total records emitted by this subtask (count-based barriers).
+        self.offset = 0
+        self.current_split: typing.Optional[SourceSplit] = None
+        self._iter: typing.Optional[typing.Iterator[typing.Any]] = None
+        self._split_started_s: typing.Optional[float] = None
+        self.splits_completed = 0
+        self._restored: typing.Optional[dict] = None
+        #: Pool snapshot staged by on_barrier for the NEXT snapshot()
+        #: call (reader 0 only) — snapshot() itself has no checkpoint-id
+        #: channel down to _operator_snapshot.
+        self._staged_pool: typing.Any = None
+        self._staged_pool_set = False
+
+    # -- wiring (executor, before open/restore) ---------------------------
+    def attach(self, coordinator: "SplitCoordinator", index: int,
+               mailbox: "SourceMailbox") -> None:
+        self.coordinator = coordinator
+        self.reader_index = index
+        self.mailbox = mailbox
+        coordinator.add_reader(index, mailbox)
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self) -> None:
+        self.reader = self.source.create_reader(self.ctx)
+        self.reader.open(self.ctx)
+        grp = self.ctx.metrics
+        # Per-split observability: how work actually distributed (the
+        # work-stealing evidence) and what each reader is chewing on now.
+        grp.gauge("splits_completed", lambda: self.splits_completed)
+        grp.gauge("current_split_id",
+                  lambda: self.current_split.split_id if self.current_split else None)
+        grp.gauge("current_split_age_s", self._split_age)
+        if self.reader_index == 0:
+            grp.gauge("splits_assigned",
+                      lambda: self.coordinator.splits_dispensed
+                      if self.coordinator else 0)
+
+    def close(self) -> None:
+        if self.reader is not None:
+            self.reader.close()
+            self.reader = None
+
+    def _split_age(self) -> typing.Optional[float]:
+        if self._split_started_s is None:
+            return None
+        return time.monotonic() - self._split_started_s
+
+    # -- record plane (called only by the run_split_source loop) ----------
+    def poll_next(self) -> typing.Tuple[str, typing.Any]:
+        """Non-blocking step: (RECORD, value) | (WAIT, due-or-None) |
+        (DONE, None).  The loop emits RECORD values immediately, so the
+        split-offset bump here cannot race a barrier (single thread,
+        barriers are served between polls)."""
+        from flink_tensorflow_tpu.sources.coordinator import (
+            ASSIGNED,
+            EXHAUSTED,
+        )
+
+        while True:
+            if self._iter is None:
+                if self.current_split is None:
+                    status, split = self.coordinator.poll_split(self.reader_index)
+                    if status == EXHAUSTED:
+                        return DONE, None
+                    if status != ASSIGNED:
+                        return WAIT, None
+                    self.current_split = split
+                # (A restored in-flight split arrives with current_split
+                # set and no iterator — same path as a fresh assignment.)
+                self._iter = self.reader.read(self.current_split)
+                self._split_started_s = time.monotonic()
+            try:
+                value = next(self._iter)
+            except StopIteration:
+                self._iter = None
+                self.current_split = None
+                self._split_started_s = None
+                self.splits_completed += 1
+                continue
+            if isinstance(value, NotReady):
+                return WAIT, value.due
+            self.current_split.offset += 1
+            return RECORD, value
+
+    def record_emitted(self) -> None:
+        self.offset += 1
+
+    def process_record(self, record):  # pragma: no cover - sources have no input
+        raise RuntimeError("SplitSourceOperator has no input")
+
+    # -- checkpoint protocol ----------------------------------------------
+    def on_barrier(self, checkpoint_id: int) -> None:
+        """Called by the loop as it cuts its stream at this barrier,
+        BEFORE snapshot(): registers passage with the coordinator and
+        stages the pool snapshot when this reader persists it."""
+        snap = self.coordinator.on_barrier(checkpoint_id, self.reader_index)
+        if self.reader_index == 0:
+            self._staged_pool = snap
+            self._staged_pool_set = True
+
+    def _operator_snapshot(self):
+        snap = {
+            "offset": self.offset,
+            "in_flight": (self.current_split.freeze()
+                          if self.current_split is not None else None),
+        }
+        if self.reader_index == 0:
+            if self._staged_pool_set:
+                pool = self._staged_pool
+                self._staged_pool = None
+                self._staged_pool_set = False
+            else:
+                # Final/job-end snapshot (no barrier staged a pool).
+                pool = (self.coordinator.live_pool_state()
+                        if self.coordinator is not None else None)
+            snap["pool"] = pool
+        return snap
+
+    def _operator_restore(self, state) -> None:
+        self._restored = dict(state)
+
+    def apply_restore(self) -> None:
+        """Push restored state where it lives: called by the executor
+        AFTER restore() delivered snapshots and BEFORE any reader thread
+        runs (so the lazily-built enumerator always sees it)."""
+        if self._restored is None:
+            return
+        state = self._restored
+        self._restored = None
+        self.offset = state.get("offset", 0)
+        # The in-flight split resumes ON THIS READER at its recorded
+        # offset (same-parallelism restore keeps locality); rescale
+        # routes old in-flight splits through "extra_splits" instead.
+        self.current_split = state.get("in_flight")
+        pool = state.get("pool")
+        if pool is not None:
+            self.coordinator.deliver_restored_state(pool)
+        extras = state.get("extra_splits")
+        if extras:
+            self.coordinator.add_splits_back(extras)
+
+    def rescale(self, old, index, parallelism, max_parallelism):
+        """Source parallelism changed across the restart: POOL everything
+        — the old unassigned splits plus every old reader's in-flight
+        split (offsets intact) — and let the new readers pull.  Reader 0
+        carries the merged pool; everyone starts with nothing in flight."""
+        snap = {"keyed": {}, "function": None,
+                "operator": {"offset": 0, "in_flight": None}}
+        if index != 0:
+            return snap
+        in_flight = []
+        pool = None
+        for s in old.values():
+            if s is None:
+                continue
+            op_state = s.get("operator") or {}
+            if op_state.get("in_flight") is not None:
+                in_flight.append(op_state["in_flight"])
+            if op_state.get("pool") is not None:
+                pool = op_state["pool"]
+        snap["operator"] = {
+            "offset": 0,
+            "in_flight": None,
+            "pool": pool,
+            "extra_splits": in_flight,
+        }
+        return snap
+
+    def finish(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.reader_finished(self.reader_index)

@@ -39,3 +39,13 @@ class TestExampleJobs:
 
         out = inception_inference.main(["--smoke", "--cpu"])
         assert out["records"] == 16 and len(out["sample_labels"]) == 5
+
+    def test_split_source_pipeline(self):
+        from examples import split_source_pipeline
+
+        out = split_source_pipeline.main(["--smoke", "--cpu"])
+        assert out["records"] == 64
+        assert sum(out["splits_per_subtask"].values()) == 8
+        assert out["every_subtask_got_work"]
+        # The timer-driven window rode the split-source chain.
+        assert out["window_chain"] == ["replay", "window", "collect"]

@@ -1,0 +1,564 @@
+"""Split-based source subsystem (ISSUE 4): FLIP-27-style
+SplitEnumerator + SourceReader + wakeable source mailbox.
+
+The acceptance contract: a skewed-split FileSplitSource at parallelism 4
+completes with every subtask finishing >= 1 split (work-stealing visible
+in the per-split metrics); mid-split failover is exactly-once (see also
+tests/test_failover.py); and a timer-driven window operator fuses into a
+split-source chain — the plan shows it and the runtime shows zero
+inter-operator queue puts on that edge.
+"""
+
+import dataclasses
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.analysis import analyze
+from flink_tensorflow_tpu.analysis.chaining import compute_chains
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.io.files import write_record_file
+from flink_tensorflow_tpu.sources import (
+    FileSplitSource,
+    ListSplitEnumerator,
+    PacedSplitSource,
+    RangeSplit,
+    ReplaySplitSource,
+    SourceMailbox,
+    range_splits,
+)
+from flink_tensorflow_tpu.tensors import TensorValue
+
+
+class _SumWindow(fn.WindowFunction):
+    def process_window(self, key, window, elements, out):
+        out.collect(sum(elements))
+
+
+def _write_skewed_files(tmp_path, sizes):
+    """One frame file per size; records carry a global running id."""
+    paths, idx = [], 0
+    for f, n in enumerate(sizes):
+        path = str(tmp_path / f"part-{f:02d}.rec")
+        write_record_file(path, [
+            TensorValue({"x": np.float32(idx + i)}, {"id": idx + i})
+            for i in range(n)
+        ])
+        idx += n
+        paths.append(path)
+    return paths, idx
+
+
+class TestSplitPrimitives:
+    def test_range_splits_partition_exactly(self):
+        splits = range_splits(10, 4)
+        covered = [i for s in splits for i in range(s.start, s.stop)]
+        assert covered == list(range(10))
+        assert len(splits) == 4
+        # More splits than records degrades to one record per split.
+        assert len(range_splits(3, 8)) == 3
+        assert range_splits(0, 4) == []
+
+    def test_enumerator_fifo_and_add_back_front(self):
+        e = ListSplitEnumerator(range_splits(12, 3))
+        first = e.next_split(0)
+        assert first.split_id == "range[0:4]"
+        e.add_splits_back([first])
+        assert e.next_split(1).split_id == "range[0:4]"  # returned work first
+
+    def test_enumerator_snapshot_insulated_from_live_mutation(self):
+        e = ListSplitEnumerator(range_splits(8, 2))
+        snap = e.snapshot_state()
+        live = e.next_split(0)
+        live.offset = 3
+        restored = ListSplitEnumerator([])
+        restored.restore_state(snap)
+        again = restored.next_split(0)
+        assert again.split_id == live.split_id and again.offset == 0
+
+    def test_mailbox_signal_not_lost_between_waits(self):
+        m = SourceMailbox()
+        m.notify()  # posted while the loop is busy, before it parks
+        assert m.wait(timeout=0.0) is True
+        t0 = time.monotonic()
+        assert m.wait(timeout=0.05) is False  # drained: now a real park
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_invalid_args_rejected(self):
+        with pytest.raises(ValueError):
+            ReplaySplitSource([1], num_splits=0)
+        with pytest.raises(ValueError):
+            FileSplitSource(["x"], records_per_split=0)
+        with pytest.raises(ValueError):
+            PacedSplitSource([1], rate_hz=0.0)
+        with pytest.raises(ValueError):
+            PacedSplitSource([1], rate_hz=1.0, cycles=0)
+
+    def test_from_source_rejects_unknown_source_type(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        with pytest.raises(TypeError):
+            env.from_source(object())
+
+
+class TestReplaySplitSource:
+    def test_every_record_exactly_once_across_readers(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_source(ReplaySplitSource(list(range(200)), num_splits=7),
+                            name="replay", parallelism=3)
+            .rebalance()
+            .map(lambda x: x, name="m", parallelism=2)
+            .sink_to_list()
+        )
+        env.execute(timeout=60)
+        assert sorted(out) == list(range(200))
+        rep = env.metric_registry.report()
+        completed = sum(rep[f"replay.{i}.splits_completed"] for i in range(3))
+        assert completed == 7
+        assert rep["replay.0.splits_assigned"] == 7
+
+    def test_chains_with_forward_downstream(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_source(ReplaySplitSource(list(range(50)), num_splits=2),
+                            name="replay", parallelism=1)
+            .map(lambda x: x * 2, name="dbl", parallelism=1)
+            .sink_to_list()
+        )
+        ex = env._make_executor()
+        assert len(ex.subtasks) == 1 and ex._gates == []
+        ex.run(timeout=60)
+        assert sorted(out) == [2 * x for x in range(50)]
+
+
+class TestFileSplitSource:
+    def test_per_file_splits_roundtrip(self, tmp_path):
+        paths, total = _write_skewed_files(tmp_path, [5, 3, 2])
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_source(FileSplitSource(paths), name="files", parallelism=2)
+            .rebalance()
+            .map(lambda r: int(r.meta["id"]), name="ids", parallelism=1)
+            .sink_to_list()
+        )
+        env.execute(timeout=60)
+        assert sorted(out) == list(range(total))
+
+    def test_records_per_split_chunks_large_files(self, tmp_path):
+        paths, total = _write_skewed_files(tmp_path, [10])
+        src = FileSplitSource(paths, records_per_split=4)
+        splits = []
+        e = src.create_enumerator()
+        while (s := e.next_split(0)) is not None:
+            splits.append(s)
+        assert [(s.start, s.stop) for s in splits] == [(0, 4), (4, 8), (8, 10)]
+        # Chunked counts need IO: no plan-time hint.
+        assert src.plan_split_count() is None
+        assert FileSplitSource(paths).plan_split_count() == 1
+
+    def test_skewed_files_work_stealing_at_parallelism_4(self, tmp_path):
+        """Acceptance: one file holds ~half the records; with 12 splits
+        and 4 pull-based readers, EVERY subtask finishes >= 1 split and
+        the reader stuck on the big file takes fewer splits than the
+        total would suggest under static striding."""
+        sizes = [60, 12, 8] + [4] * 9
+        paths, total = _write_skewed_files(tmp_path, sizes)
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.source_throttle_s = 0.001  # keep the four readers overlapped
+        out = (
+            env.from_source(FileSplitSource(paths), name="files", parallelism=4)
+            .rebalance()
+            .map(lambda r: int(r.meta["id"]), name="ids", parallelism=2)
+            .sink_to_list()
+        )
+        env.execute(timeout=120)
+        assert sorted(out) == list(range(total))
+        rep = env.metric_registry.report()
+        per_subtask = {i: rep[f"files.{i}.splits_completed"] for i in range(4)}
+        assert sum(per_subtask.values()) == len(sizes)
+        assert all(v >= 1 for v in per_subtask.values()), per_subtask
+        # Work-stealing shape: nobody took a static quarter of the
+        # RECORDS; the big-file reader completed the fewest splits.
+        assert max(per_subtask.values()) > len(sizes) // 4, per_subtask
+
+
+class TestPacedSplitSource:
+    def test_paces_and_stamps_schedule(self):
+        n, rate = 12, 120.0
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = [TensorValue({"x": np.float32(i)}, {"id": i}) for i in range(n)]
+        out = []
+        (
+            env.from_source(
+                PacedSplitSource(records, rate, jitter="none", num_splits=1),
+                name="paced", parallelism=1)
+            .sink_to_callable(lambda r: out.append(
+                (r.meta["sched_ts"], time.monotonic(), r.meta["id"])))
+        )
+        t0 = time.monotonic()
+        env.execute(timeout=60)
+        wall = time.monotonic() - t0
+        assert [rid for _, _, rid in out] == list(range(n))
+        # Open loop: the run cannot beat the schedule.
+        assert wall >= (n - 1) / rate * 0.9
+        for sched, arrived, _ in out:
+            assert arrived >= sched - 1e-3
+
+    def test_unbounded_runs_until_cancelled(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        out = (
+            env.from_source(
+                PacedSplitSource(list(range(4)), 400.0, jitter="none",
+                                 num_splits=1, cycles=None),
+                name="forever", parallelism=1)
+            .sink_to_list()
+        )
+        handle = env.execute_async()
+        time.sleep(0.3)
+        handle.cancel()
+        # Cancellation wakes the mailbox park; the thread exits promptly.
+        for st in handle.executor.subtasks:
+            st.thread.join(timeout=5.0)
+            assert not st.thread.is_alive()
+        assert len(out) > 4  # cycled past the data at least once
+
+    def test_barriers_served_during_schedule_waits(self, tmp_path):
+        """The wakeable-wait property itself: a sparse schedule parks the
+        source for ~1s stretches, yet a checkpoint triggered mid-wait
+        completes promptly instead of waiting out the sleep."""
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk"))
+        records = [TensorValue({"x": np.float32(i)}, {"id": i}) for i in range(4)]
+        (
+            env.from_source(
+                PacedSplitSource(records, 1.0, jitter="none", num_splits=1),
+                name="sparse", parallelism=1)
+            .sink_to_list()
+        )
+        handle = env.execute_async()
+        time.sleep(0.2)  # parked inside the first inter-arrival gap
+        t0 = time.monotonic()
+        snaps = handle.trigger_checkpoint(timeout=30)
+        barrier_latency = time.monotonic() - t0
+        handle.cancel()
+        handle.wait(timeout=30)
+        assert "sparse" in snaps
+        assert barrier_latency < 0.5, (
+            f"barrier took {barrier_latency:.2f}s — the mailbox wait must "
+            "be woken by the checkpoint request, not the schedule")
+
+
+class TestSplitChaining:
+    def test_timer_window_fuses_into_split_source_chain(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_source(ReplaySplitSource(list(range(32)), num_splits=2),
+                            name="replay", parallelism=1)
+            .count_window(4, timeout_s=1.0)
+            .apply(_SumWindow(), name="timed", parallelism=1)
+            .sink_to_list()
+        )
+        plan = compute_chains(env.graph)
+        assert ["replay", "timed", "collect"] in plan.names()
+        assert not any("timer-driven" in r
+                       for r in plan.unchained_reasons.values())
+
+    def test_legacy_source_still_cuts_timer_chain(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_collection(list(range(32)), parallelism=1)
+            .count_window(4, timeout_s=1.0)
+            .apply(_SumWindow(), name="timed", parallelism=1)
+            .sink_to_list()
+        )
+        plan = compute_chains(env.graph)
+        assert ["collection"] in plan.names()
+        assert any("timer-driven" in r for r in plan.unchained_reasons.values())
+
+    def test_chained_timeout_fires_mid_stream(self):
+        """A count-or-timeout window INSIDE the split-source chain must
+        flush on its wall-clock deadline while the paced source is
+        parked — the mailbox wait is bounded by the chain's earliest
+        operator deadline."""
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = list(range(12))
+        out = (
+            env.from_source(
+                PacedSplitSource(records, 50.0, jitter="none", num_splits=1),
+                name="paced", parallelism=1)
+            .count_window(100, timeout_s=0.06)
+            .apply(_SumWindow(), name="win", parallelism=1)
+            .sink_to_list()
+        )
+        ex = env._make_executor()
+        assert len(ex.subtasks) == 1 and ex._gates == []
+        ex.run(timeout=60)
+        # One finish()-flush would produce a single window; timeout fires
+        # must have split the stream into several.
+        assert len(out) >= 2
+        assert sum(out) == sum(records)
+        report = ex.metrics.report()
+        assert not [k for k in report if k.endswith("_queue_puts")]
+
+
+class TestSplitLint:
+    def test_warns_on_fewer_splits_than_parallelism(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.from_source(ReplaySplitSource(list(range(10)), num_splits=2),
+                        name="starved", parallelism=4).sink_to_list()
+        diags = [d for d in analyze(env.graph, config=env.config)
+                 if d.rule == "source-split-parallelism"]
+        assert len(diags) == 1 and diags[0].node == "starved"
+        assert "2 split(s)" in diags[0].message
+
+    def test_silent_when_splits_cover_parallelism(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.from_source(ReplaySplitSource(list(range(10)), num_splits=4),
+                        name="ok", parallelism=4).sink_to_list()
+        env.from_collection([1, 2, 3], name="legacy").sink_to_list()
+        diags = analyze(env.graph, config=env.config)
+        assert not [d for d in diags if d.rule == "source-split-parallelism"]
+
+    def test_unbounded_sources_skipped(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.from_source(
+            PacedSplitSource(list(range(4)), 100.0, num_splits=1, cycles=None),
+            name="open", parallelism=4).sink_to_list()
+        diags = analyze(env.graph, config=env.config)
+        assert not [d for d in diags if d.rule == "source-split-parallelism"]
+
+
+class TestSplitCheckpointing:
+    def _pipeline(self, env, n=200, num_splits=5, parallelism=2):
+        return (
+            env.from_source(ReplaySplitSource(list(range(n)), num_splits=num_splits),
+                            name="replay", parallelism=parallelism)
+            .rebalance()
+            .map(lambda x: x, name="m", parallelism=2)
+            .sink_to_list()
+        )
+
+    def test_mid_split_snapshot_is_consistent(self, tmp_path):
+        """At the barrier, every split is in exactly one place: a
+        reader's in-flight snapshot (with offset) or reader 0's pool
+        snapshot — and the emitted counts add up."""
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk"))
+        env.source_throttle_s = 0.005
+        self._pipeline(env)
+        handle = env.execute_async()
+        time.sleep(0.25)
+        snaps = handle.trigger_checkpoint(timeout=30)
+        handle.cancel()
+        handle.wait(timeout=30)
+        ops = {i: s["operator"] for i, s in snaps["replay"].items()}
+        emitted = sum(s["offset"] for s in ops.values())
+        assert 0 < emitted < 200, "checkpoint should be mid-stream"
+        in_flight = [s["in_flight"] for s in ops.values() if s["in_flight"]]
+        pool = ops[0]["pool"] or []
+        seen_ids = {s.split_id for s in in_flight} | {s.split_id for s in pool}
+        assert len(seen_ids) == len(in_flight) + len(pool), (
+            "a split must never be both in-flight and pooled")
+        # Records accounted: emitted + remaining(in-flight) + pooled = total.
+        remaining = sum((s.stop - s.start) - s.offset for s in in_flight)
+        pooled = sum((s.stop - s.start) - s.offset for s in pool)
+        completed = 200 - emitted - remaining - pooled
+        assert completed == 0, "every unemitted record is in-flight or pooled"
+
+    def test_restore_resumes_at_offsets_exactly_once(self, tmp_path):
+        ckpt = str(tmp_path / "chk")
+        env1 = StreamExecutionEnvironment(parallelism=1)
+        env1.enable_checkpointing(ckpt)
+        env1.source_throttle_s = 0.005
+        self._pipeline(env1)
+        handle = env1.execute_async()
+        time.sleep(0.25)
+        snaps = handle.trigger_checkpoint(timeout=30)
+        handle.cancel()
+        handle.wait(timeout=30)
+        emitted = sum(s["operator"]["offset"] for s in snaps["replay"].values())
+        assert 0 < emitted < 200
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        out = self._pipeline(env2)
+        env2.execute(restore_from=ckpt, timeout=60)
+        # Exactly the unemitted records replay, each exactly once.
+        assert len(out) == len(set(out)) == 200 - emitted
+
+    def test_rescale_redistributes_splits(self, tmp_path):
+        """Restore with a DIFFERENT source parallelism: old in-flight
+        splits and the old pool merge and redistribute; records resume
+        at their offsets (legacy stride sources raise here)."""
+        ckpt = str(tmp_path / "chk")
+        env1 = StreamExecutionEnvironment(parallelism=1)
+        env1.enable_checkpointing(ckpt)
+        env1.source_throttle_s = 0.005
+        self._pipeline(env1, parallelism=2)
+        handle = env1.execute_async()
+        time.sleep(0.25)
+        snaps = handle.trigger_checkpoint(timeout=30)
+        handle.cancel()
+        handle.wait(timeout=30)
+        emitted = sum(s["operator"]["offset"] for s in snaps["replay"].values())
+        assert 0 < emitted < 200
+
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        out = self._pipeline(env2, parallelism=3)
+        env2.execute(restore_from=ckpt, timeout=60)
+        assert len(out) == len(set(out)) == 200 - emitted
+        rep = env2.metric_registry.report()
+        assert sum(rep[f"replay.{i}.splits_completed"] for i in range(3)) >= 1
+
+    def test_count_based_barriers_on_split_source(self, tmp_path):
+        """checkpoint.every_n_records cuts at per-subtask record counts
+        on the split path too (single-process mode)."""
+        from flink_tensorflow_tpu.checkpoint.store import latest_checkpoint_id
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk"), every_n_records=20)
+        out = self._pipeline(env, n=100, num_splits=4, parallelism=1)
+        env.execute(timeout=60)
+        assert sorted(out) == list(range(100))
+        assert latest_checkpoint_id(str(tmp_path / "chk")) >= 2
+
+
+class TestPrometheusHttpEndpoint:
+    def test_scrape_round_trip(self):
+        from flink_tensorflow_tpu.metrics.reporters import PrometheusHttpReporter
+
+        r = PrometheusHttpReporter(port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/metrics", timeout=5).read().decode()
+            assert "no report yet" in body
+            r.report({"op.0": {"records_in": {"count": 3, "rate": 1.5},
+                               "queue_depth": 2}}, timestamp=123.0)
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/metrics", timeout=5).read().decode()
+            assert 'flink_tpu_records_in_count{scope="op.0"} 3' in body
+            assert 'flink_tpu_queue_depth{scope="op.0"} 2' in body
+        finally:
+            r.close()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/metrics", timeout=1)
+
+    def test_job_wiring_via_http_port(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.configure(metrics=dataclasses.replace(
+            env.config.metrics, http_port=0, report_interval_s=0.05))
+        env.source_throttle_s = 0.002
+        env.from_source(ReplaySplitSource(list(range(100)), num_splits=4),
+                        name="replay", parallelism=2) \
+           .rebalance().map(lambda x: x).sink_to_list()
+        handle = env.execute_async()
+        http = next(r for r in handle.reporter.reporters if hasattr(r, "port"))
+        time.sleep(0.2)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/", timeout=5).read().decode()
+        handle.wait(timeout=60)
+        assert "flink_tpu_splits_completed" in body
+
+    def test_invalid_port_rejected(self):
+        from flink_tensorflow_tpu.metrics.reporters import MetricConfig
+
+        with pytest.raises(ValueError):
+            MetricConfig(http_port=-1).validate()
+
+
+class TestModelOutputSchemaDerivation:
+    def _toy_model(self):
+        from flink_tensorflow_tpu.models.base import Model, ModelMethod
+        from flink_tensorflow_tpu.tensors.schema import RecordSchema, spec
+
+        in_schema = RecordSchema({"x": spec((4,))})
+
+        def apply_fn(params, inputs):
+            return {"y": inputs["x"] @ params["w"], "aux": inputs["x"]}
+
+        model = Model(
+            "toy", {"w": np.zeros((4, 2), np.float32)},
+            {"serve": ModelMethod("serve", in_schema, ("y",), apply_fn)})
+        return model, in_schema
+
+    def test_eval_shape_derives_output_schema(self):
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+        from flink_tensorflow_tpu.tensors.schema import RecordSchema, spec
+
+        model, in_schema = self._toy_model()
+        derived = ModelWindowFunction(model).output_schema(in_schema)
+        assert derived == RecordSchema({"y": spec((2,))})
+        # The outputs filter widens the derived schema accordingly.
+        both = ModelWindowFunction(model, outputs=("y", "aux"))
+        assert both.output_schema(in_schema) == RecordSchema(
+            {"y": spec((2,)), "aux": spec((4,))})
+
+    def test_lazy_sources_stay_unknown(self):
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+
+        f = ModelWindowFunction(lambda: (_ for _ in ()).throw(RuntimeError))
+        assert f.output_schema(None) is None
+
+    def test_schema_propagates_from_split_source_to_downstream(self):
+        """from_source(split) -> ModelFunction lint-checks end to end:
+        the source's declared schema validates against the model AND the
+        model's derived output schema reaches operators below it."""
+        from flink_tensorflow_tpu.analysis.schema_prop import propagate
+        from flink_tensorflow_tpu.functions import ModelWindowFunction
+        from flink_tensorflow_tpu.tensors.schema import RecordSchema, spec
+
+        model, in_schema = self._toy_model()
+        records = [TensorValue({"x": np.zeros(4, np.float32)}) for _ in range(8)]
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_source(ReplaySplitSource(records, num_splits=2,
+                                              schema=in_schema),
+                            name="split", parallelism=1)
+            .count_window(4)
+            .apply(ModelWindowFunction(model), name="model", parallelism=1)
+            .map(lambda v: v, name="below", parallelism=1)
+            .sink_to_list()
+        )
+        order = env.graph.topological_order()
+        ops = {t.id: t.operator_factory() for t in order}
+        flow = propagate(env.graph, order, ops)
+        by_name = {t.name: flow.out.get(t.id) for t in order}
+        assert by_name["split"] == in_schema
+        assert by_name["model"] == RecordSchema({"y": spec((2,))})
+        assert not [d for d in analyze(env.graph, config=env.config)
+                    if d.severity >= 2]  # no ERRORs
+
+
+@pytest.mark.slow
+class TestSplitChainLatencyGuard:
+    """Slow-tier CI guard: the chaining restriction the subsystem exists
+    to lift — a timer-driven operator chained into a split-source chain
+    runs with ZERO inter-operator queue puts while its wall-clock
+    deadline still fires."""
+
+    def test_timer_in_split_chain_zero_queue_puts(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        records = list(range(24))
+        out = (
+            env.from_source(
+                PacedSplitSource(records, 100.0, jitter="none", num_splits=2),
+                name="paced", parallelism=1)
+            .count_window(64, timeout_s=0.05)
+            .apply(_SumWindow(), name="win", parallelism=1)
+            .sink_to_list()
+        )
+        plan = compute_chains(env.graph)
+        assert ["paced", "win", "collect"] in plan.names()
+        ex = env._make_executor()
+        assert len(ex.subtasks) == 1
+        assert ex._gates == []
+        ex.run(timeout=120)
+        assert len(out) >= 2, "timeout must fire mid-stream"
+        assert sum(out) == sum(records)
+        report = ex.metrics.report()
+        assert [k for k in report if k.endswith("_queue_puts")] == []
+        assert report["win.0.chain_position"] == 1
