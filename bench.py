@@ -60,20 +60,35 @@ def _chaining_enabled(args) -> bool:
         "off", "0", "false")
 
 
+def _sanitize_enabled(args) -> bool:
+    """Debug-mode concurrency sanitizer on/off for this run: the
+    --sanitize flag wins; otherwise the FLINK_TPU_SANITIZE env var
+    (1/true/on enables).  The on mode is the overhead-attribution run:
+    every gate/mailbox/coordinator lock is instrumented and the barrier
+    protocol invariants are asserted per delivery/snapshot/dispense."""
+    if getattr(args, "sanitize", None) is not None:
+        return args.sanitize == "on"
+    return os.environ.get("FLINK_TPU_SANITIZE", "").lower() in (
+        "1", "true", "on", "yes")
+
+
 def _apply_chaining(env, args):
-    env.configure(chaining=_chaining_enabled(args))
+    env.configure(chaining=_chaining_enabled(args),
+                  sanitize=_sanitize_enabled(args))
     return env
 
 
 def _chain_report(env) -> dict:
     """The JSON tail's chain attribution: the execution chain topology
-    and whether fusion was on — BENCH_r06 reads both next to the floor
-    components to attribute the reduction."""
+    and whether fusion / the sanitizer was on — BENCH_r06 reads these
+    next to the floor components to attribute reductions (and the
+    sanitize=on row prices the instrumentation overhead)."""
     from flink_tensorflow_tpu.analysis.chaining import compute_chains
 
     plan = compute_chains(env.graph, enabled=env.config.chaining)
     return {
         "chaining": "on" if env.config.chaining else "off",
+        "sanitize": "on" if env.config.sanitize else "off",
         "chains": plan.names(),
         "chained_edges": plan.chained_edge_count,
     }
@@ -2015,6 +2030,13 @@ def main(argv=None):
                         "queue hop per operator so the floor reduction "
                         "is attributable; both modes record the chain "
                         "topology in the JSON tail")
+    p.add_argument("--sanitize", choices=["on", "off"], default=None,
+                   help="debug-mode concurrency sanitizer (default: off, "
+                        "or the FLINK_TPU_SANITIZE env var) — 'on' "
+                        "re-runs with instrumented locks/condvars and "
+                        "per-delivery barrier-invariant checks so the "
+                        "scoreboard's overhead row is attributable; "
+                        "'off' is the production zero-cost no-op path")
     p.add_argument("--open-loop-start-delay-s", type=float, default=60.0,
                    help="shift the open-loop schedule past pipeline warmup "
                         "(covers one cold XLA compile of the service bucket)")
@@ -2141,6 +2163,7 @@ def _scoreboard(outputs: list) -> dict:
         "p50_ms": flag.get("p50_record_latency_ms"),
         "p99_ms": flag.get("p99_record_latency_ms"),
         "chaining": flag.get("chaining"),
+        "sanitize": flag.get("sanitize"),
         "full_detail": "BENCH_full.json",
     }
     wire, wire_pre = flag.get("wire") or {}, flag.get("wire_pre") or {}

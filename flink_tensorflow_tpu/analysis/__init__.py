@@ -36,6 +36,12 @@ from flink_tensorflow_tpu.analysis.diagnostics import (
     worst_severity,
 )
 from flink_tensorflow_tpu.analysis.rules import RULES, AnalysisContext, LintRule, rule
+from flink_tensorflow_tpu.analysis.sanitizer import (
+    PurityFinding,
+    scan_callable,
+    scan_code,
+    scan_operator,
+)
 from flink_tensorflow_tpu.analysis.schema_prop import SchemaFlow, propagate
 
 __all__ = [
@@ -46,6 +52,7 @@ __all__ = [
     "LintRule",
     "PlanCaptured",
     "PlanValidationError",
+    "PurityFinding",
     "SchemaFlow",
     "Severity",
     "analyze",
@@ -59,6 +66,9 @@ __all__ = [
     "has_errors",
     "propagate",
     "rule",
+    "scan_callable",
+    "scan_code",
+    "scan_operator",
     "sharding_axes_of",
     "sharding_fusion_conflict",
     "worst_severity",

@@ -50,6 +50,15 @@ from flink_tensorflow_tpu.core.partitioning import ForwardPartitioner
 #: package onto the plan-construction path.
 DATA_AXIS = "data"
 
+#: Why a legacy-source chain is cut before a timer-driven member —
+#: shared with the ``legacy-source-timer-chain`` lint (analysis/rules)
+#: so the lint flags exactly the edges this pass refuses to fuse.
+TIMER_CUT_REASON = (
+    "timer-driven operator cannot chain into a source "
+    "loop (wall-clock deadlines would wait on the "
+    "source's own sleeps)"
+)
+
 
 def sharding_axes_of(function: typing.Any) -> typing.Optional[typing.Tuple[str, ...]]:
     """Mesh axes a function's jitted step shards its batch over, or None
@@ -245,11 +254,7 @@ def compute_chains(
             op = operators.get(cur.id)
             if op is not None and op.uses_timers:
                 del next_of[prev.id]
-                reasons[(prev.id, cur.id)] = (
-                    "timer-driven operator cannot chain into a source "
-                    "loop (wall-clock deadlines would wait on the "
-                    "source's own sleeps)"
-                )
+                reasons[(prev.id, cur.id)] = TIMER_CUT_REASON
                 break
             prev, cur = cur, next_of.get(cur.id)
 

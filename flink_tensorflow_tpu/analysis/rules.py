@@ -462,6 +462,76 @@ def _source_split_parallelism(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
+@rule("replay-purity", Severity.WARN)
+def _replay_purity(ctx: AnalysisContext, emit: Emit) -> None:
+    """Exactly-once recovery replays records through user functions and
+    rebuilds state from that replay: a function that reads the wall
+    clock, draws from a process-global RNG, mutates module globals,
+    captures a mutable closure, or performs I/O computes DIFFERENT
+    results on replay than it did before the failure — the restored
+    state silently diverges from "processed the stream once".  Bytecode
+    scan (analysis/sanitizer.py) over every user map/model/reader/key
+    function; ERROR on keyed-state paths (replay divergence corrupts
+    keyed state and repeats side effects per retained record), WARN
+    elsewhere.  Framework code (paced sources' open-loop clock, seeded
+    reservoirs) is exempt by construction — only user code is scanned."""
+    from flink_tensorflow_tpu.analysis.sanitizer import scan_operator
+
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        if op is None:
+            continue
+        keyed = ctx.is_keyed(t)
+        for f in scan_operator(op):
+            hard = keyed and f.kind in (
+                "wall-clock", "unseeded-random", "global-mutation", "io")
+            emit(
+                f.describe() + (
+                    "; restore will not reproduce this operator's keyed "
+                    "state — hoist the impurity out of the record path "
+                    "(seed an RNG in open(), take time from record "
+                    "timestamps, keep state in keyed state)"
+                    if hard else
+                    "; replay after restore will not reproduce the "
+                    "original output for the replayed records"
+                ),
+                node=t.name,
+                severity=Severity.ERROR if hard else Severity.WARN,
+            )
+
+
+@rule("legacy-source-timer-chain", Severity.WARN)
+def _legacy_source_timer_chain(ctx: AnalysisContext, emit: Emit) -> None:
+    """A LEGACY ``SourceFunction`` chain is cut before a timer-driven
+    operator (the source loop blocks inside the user generator and
+    cannot serve wall-clock deadlines), costing the hop a queue + thread
+    wakeup that a split source would not pay: split-source heads
+    (sources/, FLIP-27 model) wait on a wakeable mailbox bounded by the
+    chain's earliest deadline, so timer-driven members fuse behind them.
+    Flags exactly the edges the chaining pass refused (shared
+    TIMER_CUT_REASON) and recommends the migration."""
+    from flink_tensorflow_tpu.analysis.chaining import (
+        TIMER_CUT_REASON,
+        compute_chains,
+    )
+
+    plan = compute_chains(ctx.graph, operators=ctx.operators)
+    by_id = {t.id: t for t in ctx.order}
+    for (uid, did), reason in plan.unchained_reasons.items():
+        if reason != TIMER_CUT_REASON:
+            continue
+        up, down = by_id[uid], by_id[did]
+        emit(
+            f"chain is cut before timer-driven operator {down.name!r} "
+            "because its head is a legacy SourceFunction — the hop pays "
+            "a queue + thread wakeup per record; migrate the source to a "
+            "SplitSource (sources/, wakeable mailbox) so the timer-driven "
+            "member fuses into the source chain",
+            node=up.name, edge=_edge_str(
+                next(e for e in down.inputs if e.upstream.id == uid), down),
+        )
+
+
 @rule("recompile-churn", Severity.WARN)
 def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
     """Shape-signature churn at jit boundaries: several distinct schemas

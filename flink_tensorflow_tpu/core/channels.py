@@ -46,7 +46,9 @@ class InputGate:
     Flink's aligned exactly-once protocol (SURVEY.md §5).
     """
 
-    def __init__(self, num_channels: int, capacity: int = 1024):
+    def __init__(self, num_channels: int, capacity: int = 1024, *,
+                 sanitizer: typing.Optional[typing.Any] = None,
+                 name: typing.Optional[str] = None):
         self.num_channels = num_channels
         self.capacity = capacity
         self._queue: typing.Deque[typing.Tuple[int, el.StreamElement]] = (
@@ -58,12 +60,26 @@ class InputGate:
         self._replay: typing.Deque[typing.Tuple[int, el.StreamElement]] = collections.deque()
         self._blocked: typing.List[bool] = [False] * num_channels
         self._closed = False
+        #: Debug-mode sanitizer (core/sanitizer_rt): when set, the gate's
+        #: lock/condvars are instrumented (happens-before + deadlock
+        #: detection) and every delivery is checked against the barrier-
+        #: alignment state machine.  None (production) keeps plain
+        #: threading primitives and one is-None test per delivery.
+        self._san = sanitizer
+        self._san_name = name or f"gate@{id(self):x}"
         #: One lock, two wait-sets: readers park on ``_not_empty`` (woken
         #: by put/wake/close), writers on ``_not_full`` (woken by poll's
         #: dequeue and by close) — fully event-driven, no poll quantum.
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._not_full = threading.Condition(self._lock)
+        if sanitizer is not None:
+            self._lock = sanitizer.lock(f"{self._san_name}.lock")
+            self._not_empty = sanitizer.condition(
+                f"{self._san_name}.not_empty", self._lock)
+            self._not_full = sanitizer.condition(
+                f"{self._san_name}.not_full", self._lock)
+        else:
+            self._lock = threading.Lock()
+            self._not_empty = threading.Condition(self._lock)
+            self._not_full = threading.Condition(self._lock)
         # -- observability (metrics/: pull-based gauges read these) ------
         #: Deepest queue occupancy ever observed at a put (monotone max).
         self.high_watermark = 0
@@ -137,6 +153,8 @@ class InputGate:
                 self._stashed[idx].append((idx, element))
                 continue
             self.buffered_per_channel[idx] -= 1
+            if self._san is not None:
+                self._san.gate_delivered(self._san_name, idx)
             return idx, element
         deadline = None if timeout is None else (time.monotonic() + timeout)
         while True:
@@ -160,13 +178,19 @@ class InputGate:
                 self._stashed[idx].append((idx, element))
                 continue
             self.buffered_per_channel[idx] -= 1
+            if self._san is not None:
+                self._san.gate_delivered(self._san_name, idx)
             return idx, element
 
     def block_channel(self, idx: int) -> None:
         self._blocked[idx] = True
+        if self._san is not None:
+            self._san.gate_channel_blocked(self._san_name, idx)
 
     def unblock_all(self) -> None:
         self._blocked = [False] * self.num_channels
+        if self._san is not None:
+            self._san.gate_unblocked(self._san_name)
         stashed = self._stashed
         self._stashed = [collections.deque() for _ in range(self.num_channels)]
         for dq in stashed:

@@ -93,7 +93,14 @@ class CheckpointCoordinator:
         #: completeness instead of inferring it from a directory listing.
         self.job_meta_extra: typing.Dict[str, typing.Any] = {}
         self._next_id = 1
-        self._lock = threading.Lock()
+        #: Debug-mode sanitizer: the ack/trigger lock joins the
+        #: happens-before record so its ordering against the gate /
+        #: split-coordinator / mailbox locks is checked (the observed
+        #: legal order is checkpoint.lock -> split.lock -> mailbox —
+        #: any reverse acquisition is a lock-order inversion finding).
+        san = getattr(executor, "sanitizer", None)
+        self._lock = (san.lock("checkpoint.lock") if san is not None
+                      else threading.Lock())
         #: Serializes whole trigger() calls: a trigger arriving while one
         #: is in flight (manual colliding with the periodic timer) queues
         #: behind it instead of failing.

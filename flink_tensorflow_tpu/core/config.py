@@ -104,6 +104,17 @@ class JobConfig:
     #: operator, the pre-chaining layout); per-operator opt-outs are
     #: ``stream.start_new_chain()`` / ``stream.disable_chaining()``.
     chaining: bool = True
+    #: Debug-mode concurrency sanitizer (core.sanitizer_rt): instrument
+    #: the runtime's locks/condvars (channels, source mailboxes, split
+    #: and checkpoint coordinators), record a happens-before graph with
+    #: lock-order-inversion + waits-for-deadlock detection, and assert
+    #: the barrier protocol invariants (no record past a blocked channel
+    #: during alignment, snapshot order == chain stream order, split
+    #: assignment frozen during the enumerator-pool snapshot).  Off (the
+    #: default) is a zero-cost no-op path — plain threading primitives.
+    #: The FLINK_TPU_SANITIZE=1 env var force-enables it without config
+    #: changes; FLINK_TPU_SANITIZE_STALL_S adds the stall watchdog.
+    sanitize: bool = False
     #: Sleep between source emissions — test/backpressure pacing.
     source_throttle_s: float = 0.0
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)

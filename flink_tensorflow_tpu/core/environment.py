@@ -315,6 +315,7 @@ class StreamExecutionEnvironment:
             checkpoint_retain_last=cfg.checkpoint.retain_last,
             max_parallelism=cfg.max_parallelism,
             chaining=cfg.chaining,
+            sanitize=cfg.sanitize,
         )
         if cfg.distributed is not None:
             from flink_tensorflow_tpu.core.distributed import DistributedExecutor

@@ -171,6 +171,12 @@ class _Subtask:
         self.edge_of_channel = edge_of_channel or [0] * num_input_channels
         self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
         self._control_lock = threading.Lock()
+        #: Checkpoint ids this SPLIT-source subtask already cut its
+        #: stream at.  A barrier can now reach the reader on three
+        #: paths — control drain (trigger), count-based position, and
+        #: the freeze-deadlock guard below — and racing paths must not
+        #: cut (= snapshot + ack) the same id twice.
+        self._barriers_cut: typing.Set[int] = set()
         #: sources.mailbox.SourceMailbox for split-source subtasks (set
         #: by _build) — the ONE wait point of run_split_source; barrier
         #: requests and notifications posted here wake the loop.
@@ -248,6 +254,13 @@ class _Subtask:
     def snapshot_unit(self, unit: _ChainedUnit, checkpoint_id: typing.Optional[int]) -> None:
         """Snapshot + ack ONE chained logical operator (called by
         ChainedOutput as the barrier traverses the chain in order)."""
+        san = self.executor.sanitizer
+        if san is not None and checkpoint_id is not None:
+            # Independent snapshot-order state machine: within this
+            # subtask, checkpoint k must snapshot the chain head-to-tail
+            # with no gaps (snapshot order == stream order).
+            san.chain_snapshot(self.scope, checkpoint_id,
+                               self.units.index(unit), len(self.units))
         snapshot = unit.operator.snapshot(checkpoint_id)
         self.executor.coordinator.ack(
             checkpoint_id, unit.t.name, unit.index, snapshot)
@@ -304,7 +317,13 @@ class _Subtask:
         """Cut this reader's stream at a barrier: register with the
         split coordinator FIRST (freezing assignment and, for reader 0,
         staging the consistent enumerator-pool snapshot), then snapshot
-        this subtask and push the barrier down the chain."""
+        this subtask and push the barrier down the chain.  Idempotent
+        per id: the same checkpoint may be requested via trigger
+        control, reached count-based, AND served by the freeze-deadlock
+        guard — only the first cut snapshots and acks."""
+        if checkpoint_id in self._barriers_cut:
+            return
+        self._barriers_cut.add(checkpoint_id)
         op = typing.cast("typing.Any", self.operator)
         op.on_barrier(checkpoint_id)
         self._snapshot_and_ack(checkpoint_id)
@@ -360,6 +379,21 @@ class _Subtask:
                     continue
                 if kind == DONE:
                     break
+                # Freeze-deadlock guard: a reader parked split-less on a
+                # frozen assignment emits no records, so with count-based
+                # triggers it would NEVER reach the position that makes
+                # it cut the pending barrier — the alignment waits on
+                # this reader and this reader on the alignment's freeze.
+                # Cut the stream for every pending alignment here, at
+                # the wait point (positions are per-run for split
+                # sources anyway; sources/operator.py docstring), then
+                # re-poll: completing the alignment may unfreeze splits.
+                served = False
+                for cid in op.pending_alignments():
+                    self._split_barrier(cid)
+                    served = True
+                if served:
+                    continue
                 # WAIT: nothing to do until `payload` (a record's due
                 # time, or None = until an event) / the chain's earliest
                 # timer — park on the mailbox, charging idle time.
@@ -508,10 +542,22 @@ class LocalExecutor:
         checkpoint_retain_last: typing.Optional[int] = None,
         max_parallelism: int = 128,
         chaining: bool = True,
+        sanitize: bool = False,
     ):
+        from flink_tensorflow_tpu.core import sanitizer_rt
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
 
         self.graph = graph
+        #: Debug-mode concurrency sanitizer (core/sanitizer_rt):
+        #: JobConfig.sanitize=True or FLINK_TPU_SANITIZE=1 instruments
+        #: every gate/mailbox/coordinator lock and asserts the barrier
+        #: protocol invariants; None (the default) leaves the runtime's
+        #: production no-op path — plain threading primitives, one
+        #: is-None test per hook site.
+        self.sanitizer = (
+            sanitizer_rt.ConcurrencySanitizer(name="executor")
+            if (sanitize or sanitizer_rt.env_enabled()) else None
+        )
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         self.device_provider = device_provider
@@ -541,6 +587,13 @@ class LocalExecutor:
         self._all_done = threading.Event()
         self._periodic_thread: typing.Optional[threading.Thread] = None
         self._build()
+        if self.sanitizer is not None:
+            # Observability: the sanitizer reports through the same
+            # metric plane as everything else (inspector/reporters show
+            # violation counts next to the runtime gauges).
+            grp = self.metrics.group("sanitizer")
+            grp.gauge("violations", lambda: len(self.sanitizer.violations))
+            grp.gauge("tracked_ops", lambda: self.sanitizer.progress_ops)
 
     # --- plan construction ----------------------------------------------
     def _build(self) -> None:
@@ -622,7 +675,9 @@ class LocalExecutor:
                 operators = [member.operator_factory() for member in chain]
                 gate = None
                 if not t.is_source:
-                    gate = InputGate(gate_size[t.id], capacity=self.channel_capacity)
+                    gate = InputGate(gate_size[t.id], capacity=self.channel_capacity,
+                                     sanitizer=self.sanitizer,
+                                     name=f"{t.name}.{i}.gate")
                     gates[(t.id, i)] = gate
                     self._gates.append(gate)
                 st = _Subtask(self, chain, i, operators, gate, gate_size[t.id],
@@ -630,7 +685,8 @@ class LocalExecutor:
                 if t.is_source and getattr(operators[0], "is_split_source", False):
                     from flink_tensorflow_tpu.sources.mailbox import SourceMailbox
 
-                    st.mailbox = SourceMailbox()
+                    st.mailbox = SourceMailbox(sanitizer=self.sanitizer,
+                                               name=f"{t.name}.{i}.mailbox")
                 subtasks.append(st)
             by_head[t.id] = subtasks
 
@@ -822,7 +878,8 @@ class LocalExecutor:
                     SplitCoordinator,
                 )
 
-                coord = SplitCoordinator(source, t.parallelism)
+                coord = SplitCoordinator(source, t.parallelism,
+                                         sanitizer=self.sanitizer, name=t.name)
                 self._split_coordinators[t.name] = coord
             return coord
 
@@ -987,6 +1044,13 @@ class LocalExecutor:
                     )
         if self._error is not None:
             raise JobFailure(f"job failed: {self._error!r}") from self._error
+        if self.sanitizer is not None:
+            # The job is drained: any recorded violation is a real
+            # protocol/lock-discipline bug — surface it as loudly as a
+            # failed job (SanitizerError is NOT a JobFailure: restart
+            # strategies must not replay over a concurrency bug).
+            self.sanitizer.shutdown()
+            self.sanitizer.check()
 
     def run(self, timeout: typing.Optional[float] = None) -> None:
         self.start()
@@ -1006,7 +1070,11 @@ class LocalExecutor:
             gate.close()
         for st in self.subtasks:
             if st.mailbox is not None:
-                st.mailbox.notify()
+                # close(), not notify(): the sticky shutdown signal is
+                # immune to the notify/park race (a one-shot signal
+                # consumed by an unrelated wakeup would strand the loop
+                # parked between its cancelled-check and its wait).
+                st.mailbox.close()
         self.coordinator.cancel_pending()
 
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:

@@ -1,0 +1,515 @@
+"""Debug-mode concurrency sanitizer — the runtime half of the pipeline
+sanitizer (the plan-time half is ``analysis/sanitizer.py``).
+
+PRs 3-4 made the runtime deeply concurrent: one thread per operator
+chain, condition-variable channels (core/channels), a wakeable source
+mailbox (sources/mailbox), barrier-frozen split assignment
+(sources/coordinator), and a checkpoint coordinator fanning barriers
+across all of them.  That is exactly the territory where lost wakeups,
+lock-order inversions, and protocol bugs silently break the
+exactly-once guarantees inherited from the Flink lineage (Carbone et
+al., "Lightweight Asynchronous Snapshots for Distributed Dataflows").
+This module is a ThreadSanitizer-style (Serebryany & Iskhodzhanov)
+*happens-before* recorder scoped to that machinery:
+
+**Lock discipline.**  :meth:`ConcurrencySanitizer.lock` /
+:meth:`ConcurrencySanitizer.condition` hand out instrumented wrappers
+that record, per thread, which locks are held and in what order.  Every
+``A-held-while-acquiring-B`` pair adds an edge to a global lock-order
+graph; a pair observed in BOTH directions (even on different runs of
+the job, even if the timing never actually deadlocked) is a
+**lock-order inversion** violation.  An acquire whose owner is
+(transitively) waiting on a lock the acquiring thread holds is a
+**waits-for deadlock cycle** — recorded AND raised immediately as
+:class:`SanitizerError`, so the test observes a diagnostic instead of a
+hang.
+
+**Stall watchdog.**  With ``stall_timeout_s`` set (constructor arg or
+``FLINK_TPU_SANITIZE_STALL_S``), a daemon watchdog flags any thread
+parked in an UNTIMED instrumented wait — a condvar wait with no
+timeout, or a blocking lock acquire — longer than the budget, and dumps
+every thread's stack plus the full lock-ownership/wait map.  This is
+how a *lost wakeup* surfaces: the buggy wait that checked its predicate
+before parking (instead of consuming a pending signal under the lock)
+stalls forever, and the dump shows exactly where.  Off by default:
+healthy pipelines park untimed legitimately (an idle worker waits for
+its source through a 30 s XLA compile), so the stall budget is a test /
+triage knob, not a steady-state invariant.
+
+**Protocol state machines.**  Independent re-derivations of the
+runtime's checkpoint invariants, fed by hooks at the protocol points —
+they catch a buggy *implementation* because they do not trust it:
+
+- *barrier alignment*: no element may be delivered from a channel that
+  is blocked for alignment (``gate_channel_blocked`` /
+  ``gate_delivered``) — Flink's aligned exactly-once contract;
+- *chain snapshot order*: within one subtask, checkpoint ``k`` must
+  snapshot the chained operators head-to-tail with no gaps
+  (``chain_snapshot``) — snapshot order equals stream order;
+- *assignment freeze*: a split coordinator must not dispense splits
+  while any barrier alignment is in flight (``split_dispensed``) — the
+  enumerator-pool snapshot consistency rule of sources/coordinator.
+
+Enabled by ``JobConfig(sanitize=True)`` or ``FLINK_TPU_SANITIZE=1``.
+When off, nothing here is constructed: the runtime takes plain
+``threading`` primitives and guards every hook behind a single
+``is-None`` check, so the production path stays a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+import typing
+
+logger = logging.getLogger(__name__)
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def env_enabled() -> bool:
+    """Whether ``FLINK_TPU_SANITIZE`` force-enables the sanitizer."""
+    return os.environ.get("FLINK_TPU_SANITIZE", "").lower() in _TRUTHY
+
+
+def env_stall_timeout_s() -> typing.Optional[float]:
+    raw = os.environ.get("FLINK_TPU_SANITIZE_STALL_S")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("FLINK_TPU_SANITIZE_STALL_S=%r is not a float; ignored", raw)
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One recorded sanitizer finding."""
+
+    kind: str  # lock-order-inversion | deadlock-cycle | stall | barrier-blocked-channel | snapshot-order | assignment-freeze
+    message: str
+    thread: str
+    #: Full state dump captured at detection time (stacks + ownership)
+    #: for the kinds where post-mortem context matters.
+    dump: typing.Optional[str] = None
+
+    def format(self) -> str:
+        return f"[{self.kind}] ({self.thread}) {self.message}"
+
+
+class SanitizerError(RuntimeError):
+    """Raised when the sanitizer's invariants are violated.
+
+    Deliberately NOT a :class:`~flink_tensorflow_tpu.core.runtime.
+    JobFailure`: a concurrency-protocol violation is a bug, and restart
+    strategies must not paper over it with a replay."""
+
+    def __init__(self, violations: typing.Sequence[Violation]):
+        self.violations = list(violations)
+        super().__init__(
+            f"{len(self.violations)} sanitizer violation(s):\n"
+            + "\n".join(v.format() for v in self.violations)
+        )
+
+
+class InstrumentedLock:
+    """A ``threading.Lock`` that reports acquire/release to the sanitizer.
+
+    Works as the lock argument of ``threading.Condition`` (provides
+    ``_is_owned``); ``Condition.wait`` then routes its release/re-acquire
+    through these hooks too, so a thread re-acquiring after a wake shows
+    up in the waits-for graph like any other blocked acquirer.
+    """
+
+    __slots__ = ("_lock", "_san", "name", "_owner_tid")
+
+    def __init__(self, san: "ConcurrencySanitizer", name: str):
+        self._lock = threading.Lock()
+        self._san = san
+        self.name = name
+        self._owner_tid: typing.Optional[int] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tid = threading.get_ident()
+        if self._lock.acquire(False):
+            self._owner_tid = tid
+            self._san.on_acquired(self.name)
+            return True
+        if not blocking:
+            return False
+        self._san.on_acquiring(self.name)  # may raise on a waits-for cycle
+        try:
+            got = self._lock.acquire(True, timeout)
+        finally:
+            self._san.on_wait_exit()
+        if got:
+            self._owner_tid = tid
+            self._san.on_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._owner_tid = None
+        self._san.on_released(self.name)
+        self._lock.release()
+
+    def _is_owned(self) -> bool:
+        return self._owner_tid == threading.get_ident()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class InstrumentedCondition:
+    """``threading.Condition`` facade recording wait/notify spans.
+
+    Several conditions may share one :class:`InstrumentedLock` (the
+    channel gate's two wait-sets do) — pass the same lock object."""
+
+    __slots__ = ("_cond", "_san", "name", "lock")
+
+    def __init__(self, san: "ConcurrencySanitizer", name: str,
+                 lock: typing.Optional[InstrumentedLock] = None):
+        self.lock = lock if lock is not None else san.lock(f"{name}.lock")
+        self._cond = threading.Condition(self.lock)
+        self._san = san
+        self.name = name
+
+    def wait(self, timeout: typing.Optional[float] = None) -> bool:
+        self._san.on_wait_enter(self.name, timed=timeout is not None)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._san.on_wait_exit()
+
+    def notify(self, n: int = 1) -> None:
+        self._san.on_notify(self.name)
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._san.on_notify(self.name)
+        self._cond.notify_all()
+
+    def __enter__(self) -> "InstrumentedCondition":
+        self.lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.lock.release()
+
+
+class ConcurrencySanitizer:
+    """Happens-before recorder + invariant checker for one job.
+
+    All public hooks are thread-safe; internal state lives behind one
+    plain (uninstrumented) mutex, which is only ever acquired INSIDE an
+    instrumented operation — a fixed, acyclic two-level order."""
+
+    def __init__(self, name: str = "job", *,
+                 stall_timeout_s: typing.Optional[float] = None,
+                 raise_on_cycle: bool = True):
+        self.name = name
+        self.stall_timeout_s = (
+            stall_timeout_s if stall_timeout_s is not None else env_stall_timeout_s()
+        )
+        self.raise_on_cycle = raise_on_cycle
+        self.violations: typing.List[Violation] = []
+        self._mu = threading.Lock()
+        #: lock name -> owning thread id (while held).
+        self._owner: typing.Dict[str, int] = {}
+        #: thread id -> lock names currently held, in acquisition order.
+        self._held: typing.Dict[int, typing.List[str]] = {}
+        #: thread id -> (kind, target name, since monotonic, timed) while
+        #: blocked in an instrumented acquire ("lock") or wait ("cond").
+        self._waiting: typing.Dict[int, typing.Tuple[str, str, float, bool]] = {}
+        #: lock-order graph: edges a -> {b}: b was acquired while a held.
+        self._order: typing.Dict[str, typing.Set[str]] = {}
+        #: inversions already reported (unordered pair), so one bad pair
+        #: logs once, not once per record.
+        self._reported_pairs: typing.Set[frozenset] = set()
+        # -- protocol state machines --------------------------------------
+        #: gate name -> channel indices blocked for barrier alignment.
+        self._gate_blocked: typing.Dict[str, typing.Set[int]] = {}
+        #: (subtask scope, checkpoint id) -> next expected chain position.
+        self._chain_pos: typing.Dict[typing.Tuple[str, int], int] = {}
+        #: observability counters (runtime exposes them as gauges).
+        self.progress_ops = 0
+        self._watchdog: typing.Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        #: (tid, since) incidents the watchdog already flagged.
+        self._stalled: typing.Set[typing.Tuple[int, float]] = set()
+
+    # -- factories ---------------------------------------------------------
+    def lock(self, name: str) -> InstrumentedLock:
+        return InstrumentedLock(self, name)
+
+    def condition(self, name: str,
+                  lock: typing.Optional[InstrumentedLock] = None) -> InstrumentedCondition:
+        return InstrumentedCondition(self, name, lock)
+
+    # -- lock hooks --------------------------------------------------------
+    def on_acquiring(self, name: str) -> None:
+        """A blocking acquire is about to park: register the wait and
+        look for a waits-for cycle through the current owners."""
+        tid = threading.get_ident()
+        with self._mu:
+            self._maybe_start_watchdog()
+            self._waiting[tid] = ("lock", name, time.monotonic(), False)
+            cycle = self._deadlock_cycle_locked(tid, name)
+            if cycle is None:
+                return
+            dump = self._dump_locked()
+            v = Violation(
+                kind="deadlock-cycle",
+                message=("waits-for cycle: "
+                         + " -> ".join(cycle)
+                         + f" -> {name} (each lock's owner is blocked on the next)"),
+                thread=threading.current_thread().name,
+                dump=dump,
+            )
+            self._record_locked(v)
+            self._waiting.pop(tid, None)
+        if self.raise_on_cycle:
+            raise SanitizerError([v])
+
+    def on_acquired(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self.progress_ops += 1
+            held = self._held.setdefault(tid, [])
+            for prior in held:
+                if prior == name:
+                    continue
+                edge_known = name in self._order.get(prior, ())
+                if not edge_known and self._path_exists_locked(name, prior):
+                    pair = frozenset((prior, name))
+                    if pair not in self._reported_pairs:
+                        self._reported_pairs.add(pair)
+                        self._record_locked(Violation(
+                            kind="lock-order-inversion",
+                            message=(f"lock {name!r} acquired while holding "
+                                     f"{prior!r}, but the opposite order "
+                                     f"{name!r} -> {prior!r} was also observed "
+                                     "— a timing-dependent deadlock"),
+                            thread=threading.current_thread().name,
+                            dump=self._dump_locked(),
+                        ))
+                self._order.setdefault(prior, set()).add(name)
+            held.append(name)
+            self._owner[name] = tid
+
+    def on_released(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self.progress_ops += 1
+            if self._owner.get(name) == tid:
+                del self._owner[name]
+            held = self._held.get(tid)
+            if held and name in held:
+                held.remove(name)
+
+    # -- condvar hooks -----------------------------------------------------
+    def on_wait_enter(self, name: str, *, timed: bool) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self._maybe_start_watchdog()
+            self._waiting[tid] = ("cond", name, time.monotonic(), timed)
+
+    def on_wait_exit(self) -> None:
+        tid = threading.get_ident()
+        with self._mu:
+            self.progress_ops += 1
+            self._waiting.pop(tid, None)
+
+    def on_notify(self, name: str) -> None:
+        with self._mu:
+            self.progress_ops += 1
+
+    # -- protocol hooks: barrier alignment ---------------------------------
+    def gate_channel_blocked(self, gate: str, idx: int) -> None:
+        with self._mu:
+            self._gate_blocked.setdefault(gate, set()).add(idx)
+
+    def gate_unblocked(self, gate: str) -> None:
+        with self._mu:
+            self._gate_blocked.pop(gate, None)
+
+    def gate_delivered(self, gate: str, idx: int) -> None:
+        """An element left the gate toward the operator on channel
+        ``idx`` — a protocol violation if that channel is blocked for a
+        barrier alignment (the element overtook the checkpoint cut)."""
+        with self._mu:
+            self.progress_ops += 1
+            if idx in self._gate_blocked.get(gate, ()):
+                self._record_locked(Violation(
+                    kind="barrier-blocked-channel",
+                    message=(f"gate {gate!r} delivered an element from "
+                             f"channel {idx} while that channel is blocked "
+                             "for barrier alignment — the record overtakes "
+                             "the checkpoint cut and breaks exactly-once"),
+                    thread=threading.current_thread().name,
+                ))
+
+    # -- protocol hooks: chain snapshot order ------------------------------
+    def chain_snapshot(self, scope: str, checkpoint_id: int,
+                       position: int, chain_len: int) -> None:
+        """Subtask ``scope`` snapshots its chain member at ``position``
+        (0 = head) for ``checkpoint_id``.  Order must be exactly
+        0, 1, ..., chain_len-1 — snapshot order equals stream order."""
+        key = (scope, checkpoint_id)
+        with self._mu:
+            self.progress_ops += 1
+            expected = self._chain_pos.get(key, 0)
+            if position != expected:
+                self._record_locked(Violation(
+                    kind="snapshot-order",
+                    message=(f"subtask {scope!r} snapshot chain position "
+                             f"{position} for checkpoint {checkpoint_id}, "
+                             f"expected {expected} — snapshot order must "
+                             "match chain stream order (head to tail, no "
+                             "gaps)"),
+                    thread=threading.current_thread().name,
+                ))
+            if position + 1 >= chain_len:
+                self._chain_pos.pop(key, None)
+            else:
+                self._chain_pos[key] = position + 1
+
+    # -- protocol hooks: split assignment freeze ---------------------------
+    def split_dispensed(self, source: str, *, frozen: bool) -> None:
+        with self._mu:
+            self.progress_ops += 1
+            if frozen:
+                self._record_locked(Violation(
+                    kind="assignment-freeze",
+                    message=(f"split source {source!r} dispensed a split "
+                             "while assignment is frozen for barrier "
+                             "alignment — the enumerator-pool snapshot can "
+                             "no longer be consistent with the readers' "
+                             "in-flight-split snapshots"),
+                    thread=threading.current_thread().name,
+                ))
+
+    # -- recording / reporting ---------------------------------------------
+    def _record_locked(self, v: Violation) -> None:
+        self.violations.append(v)
+        logger.error("sanitizer violation %s%s", v.format(),
+                     f"\n{v.dump}" if v.dump else "")
+
+    def check(self) -> None:
+        """Raise :class:`SanitizerError` if any violation was recorded."""
+        if self.violations:
+            raise SanitizerError(self.violations)
+
+    def report(self) -> str:
+        if not self.violations:
+            return f"sanitizer[{self.name}]: clean ({self.progress_ops} tracked ops)"
+        return "\n".join(v.format() for v in self.violations)
+
+    def dump_state(self) -> str:
+        with self._mu:
+            return self._dump_locked()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    # -- internals (caller holds self._mu) ---------------------------------
+    def _path_exists_locked(self, src: str, dst: str) -> bool:
+        """DFS reachability src -> dst in the lock-order graph."""
+        stack, seen = [src], {src}
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            for nxt in self._order.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    def _deadlock_cycle_locked(
+        self, tid: int, name: str
+    ) -> typing.Optional[typing.List[str]]:
+        """Follow owner -> waited-lock -> owner from ``name``; a chain
+        that ends at ``tid`` is a real waits-for deadlock cycle."""
+        path = [name]
+        owner = self._owner.get(name)
+        seen_threads: typing.Set[int] = set()
+        while owner is not None and owner != tid:
+            if owner in seen_threads:
+                return None  # a cycle, but not through us
+            seen_threads.add(owner)
+            wait = self._waiting.get(owner)
+            if wait is None or wait[0] != "lock":
+                return None
+            path.append(wait[1])
+            owner = self._owner.get(wait[1])
+        return path if owner == tid else None
+
+    def _dump_locked(self) -> str:
+        """All thread stacks + lock ownership + wait map — the stall /
+        deadlock post-mortem payload."""
+        lines = [f"=== sanitizer[{self.name}] state dump ==="]
+        lines.append("lock owners: " + (
+            ", ".join(f"{n} -> tid {t}" for n, t in sorted(self._owner.items()))
+            or "(none held)"))
+        for tid, (kind, target, since, timed) in sorted(self._waiting.items()):
+            lines.append(
+                f"tid {tid}: waiting ({kind}{'' if timed else ', UNTIMED'}) on "
+                f"{target!r} for {time.monotonic() - since:.3f}s")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append(f"--- thread {names.get(tid, '?')} (tid {tid}) ---")
+            lines.append("".join(traceback.format_stack(frame)).rstrip())
+        return "\n".join(lines)
+
+    # -- stall watchdog ----------------------------------------------------
+    def _maybe_start_watchdog(self) -> None:
+        """Start the watchdog lazily at the first tracked wait (caller
+        holds ``self._mu``) — a sanitizer that never parks never needs
+        one."""
+        if (self.stall_timeout_s is None or self._watchdog is not None
+                or self._stop.is_set()):
+            return
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name=f"sanitizer-watchdog[{self.name}]",
+            daemon=True,
+        )
+        self._watchdog.start()
+
+    def _watchdog_loop(self) -> None:
+        budget = self.stall_timeout_s
+        interval = max(0.01, min(budget / 4.0, 1.0))
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._mu:
+                for tid, (kind, target, since, timed) in list(self._waiting.items()):
+                    if timed or now - since < budget:
+                        continue  # a timed wait always wakes itself
+                    incident = (tid, since)
+                    if incident in self._stalled:
+                        continue
+                    self._stalled.add(incident)
+                    self._record_locked(Violation(
+                        kind="stall",
+                        message=(f"thread tid {tid} has been parked in an "
+                                 f"untimed {kind} wait on {target!r} for "
+                                 f"{now - since:.3f}s (> {budget}s) with no "
+                                 "wakeup — lost-wakeup / missing-notify "
+                                 "suspect; full stack + ownership dump "
+                                 "attached"),
+                        thread=f"tid-{tid}",
+                        dump=self._dump_locked(),
+                    ))

@@ -164,7 +164,14 @@ class ExactlyOnceRecordFileSink(fn.SinkFunction):
             except ValueError:
                 continue
             if txn >= self._txn:
-                os.unlink(os.path.join(self.directory, name))
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except FileNotFoundError:
+                    # A cancelled previous attempt's sink thread may
+                    # still be aborting its own staged files (JobHandle
+                    # .cancel() does not join subtask threads) — the
+                    # retraction goal is "file gone", and it is.
+                    pass
 
     def invoke(self, value) -> None:
         if not isinstance(value, TensorValue):

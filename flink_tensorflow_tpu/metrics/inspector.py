@@ -30,7 +30,10 @@ import typing
 Row = typing.Dict[str, typing.Any]
 
 #: Scopes that are job-level, not operator subtasks.
-_JOB_SCOPES = {"checkpoint"}
+#: Job-level (non-subtask) scopes surfaced in the snapshot's "job"
+#: block: checkpoint bookkeeping and, under FLINK_TPU_SANITIZE=1, the
+#: concurrency sanitizer's violation/tracked-ops gauges.
+_JOB_SCOPES = {"checkpoint", "sanitizer"}
 
 
 def _split_scope(scope: str) -> typing.Tuple[str, typing.Optional[int]]:

@@ -48,10 +48,18 @@ EXHAUSTED = "exhausted"
 
 
 class SplitCoordinator:
-    def __init__(self, source: SplitSource, num_readers: int):
+    def __init__(self, source: SplitSource, num_readers: int, *,
+                 sanitizer: typing.Optional[typing.Any] = None,
+                 name: str = "split-source"):
         self.source = source
         self.num_readers = num_readers
-        self._lock = threading.Lock()
+        #: Debug-mode sanitizer (core/sanitizer_rt): instruments this
+        #: lock and asserts the assignment-freeze invariant at every
+        #: dispense; None (production) is a plain lock and no checks.
+        self._san = sanitizer
+        self._name = name
+        self._lock = (sanitizer.lock(f"{name}.coordinator")
+                      if sanitizer is not None else threading.Lock())
         self._mailboxes: typing.Dict[int, "SourceMailbox"] = {}
         self._enumerator: typing.Optional[SplitEnumerator] = None
         #: Enumerator state delivered by restore() BEFORE the job starts
@@ -120,11 +128,23 @@ class SplitCoordinator:
                 # Assignment frozen mid-alignment; the barrier-complete
                 # path notifies every mailbox.
                 return WAIT, None
-            split = self._ensure_enumerator().next_split(reader_index)
-            if split is None:
-                return (EXHAUSTED if self.source.bounded else WAIT), None
-            self.splits_dispensed += 1
-            return ASSIGNED, split
+            return self._dispense_locked(reader_index)
+
+    def _dispense_locked(
+        self, reader_index: int
+    ) -> typing.Tuple[str, typing.Optional[SourceSplit]]:
+        """Hand the next split to ``reader_index`` (caller holds the lock
+        and has honored the alignment freeze).  The sanitizer re-checks
+        the freeze here precisely because it does NOT trust the caller —
+        a dispense while any alignment is in flight breaks the pool
+        snapshot's consistency and is flagged."""
+        split = self._ensure_enumerator().next_split(reader_index)
+        if split is None:
+            return (EXHAUSTED if self.source.bounded else WAIT), None
+        self.splits_dispensed += 1
+        if self._san is not None:
+            self._san.split_dispensed(self._name, frozen=bool(self._aligning))
+        return ASSIGNED, split
 
     # -- checkpoint protocol ---------------------------------------------
     def on_barrier(self, checkpoint_id: int, reader_index: int) -> typing.Optional[typing.Any]:
@@ -146,6 +166,21 @@ class SplitCoordinator:
         if done:
             self._notify_all()
         return snap
+
+    def pending_alignments(self, reader_index: int) -> typing.List[int]:
+        """Checkpoint ids whose alignment is frozen on this coordinator
+        and which ``reader_index`` has NOT passed yet, ascending.
+
+        Exists for the runtime's freeze-deadlock guard: a reader parked
+        split-less on the freeze emits no records, so with count-based
+        triggers it can never reach the stream position that would make
+        it cut the pending barrier — the alignment would wait on the
+        reader and the reader on the alignment, forever.  (Found by the
+        PR 5 sanitizer's stall watchdog; see _Subtask.run_split_source.)
+        The runtime serves these barriers at the wait point instead."""
+        with self._lock:
+            return sorted(cid for cid, passed in self._aligning.items()
+                          if reader_index not in passed)
 
     def reader_finished(self, reader_index: int) -> None:
         """A reader's subtask ended (bounded input drained or failure

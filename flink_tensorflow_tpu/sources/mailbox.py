@@ -15,7 +15,7 @@ event arrives first:
   alignment, splits added back on failover),
 - an operator-owned background thread completing work (``ctx.wakeup`` —
   e.g. the model runner's fetch thread, for chained members),
-- job cancellation.
+- job cancellation (``close`` — sticky, see below).
 
 This is the FLIP-27/FLINK-10653 mailbox model scoped to one subtask: a
 single thread, a single wait point, everything else posts events.  It is
@@ -38,27 +38,65 @@ class SourceMailbox:
     barrier posted in that window would sit unserved until the next
     unrelated wakeup.  ``wait`` consumes pending signals first and only
     then parks.
+
+    Shutdown is a separate, STICKY signal: ``close()`` marks the mailbox
+    closed and wakes every waiter, and once closed every current and
+    future ``wait`` returns immediately.  A one-shot ``notify`` cannot
+    carry shutdown safely — the loop thread may be anywhere between its
+    cancelled-check and its park when the teardown races in, and a
+    consumed (or not-yet-counted) signal would strand it parked forever.
+    Both ``close`` and ``notify`` are idempotent and safe from any
+    thread, in any order, any number of times.
+
+    With a debug-mode sanitizer (core/sanitizer_rt) the condvar is
+    instrumented, so a stranded waiter shows up in the stall watchdog's
+    stack dump with this mailbox's name.
     """
 
-    __slots__ = ("_cond", "_signals")
+    __slots__ = ("_cond", "_signals", "_closed")
 
-    def __init__(self) -> None:
-        self._cond = threading.Condition()
+    def __init__(self, *, sanitizer: typing.Optional[typing.Any] = None,
+                 name: typing.Optional[str] = None) -> None:
+        if sanitizer is not None:
+            self._cond = sanitizer.condition(name or f"mailbox@{id(self):x}")
+        else:
+            self._cond = threading.Condition()
         self._signals = 0
+        self._closed = False
 
     def notify(self) -> None:
         """Post an event: wake the parked loop (or mark the signal so the
-        next wait returns immediately).  Safe from any thread."""
+        next wait returns immediately).  Safe from any thread; a no-op
+        after ``close`` (the sticky shutdown signal supersedes it)."""
         with self._cond:
+            if self._closed:
+                return
             self._signals += 1
             self._cond.notify()
 
-    def wait(self, timeout: typing.Optional[float]) -> bool:
-        """Park until a notify or ``timeout`` seconds (None = until
-        notified).  Returns True when woken by a signal, False on
-        timeout.  All pending signals are drained in one wait — the loop
-        re-examines every event source each iteration anyway."""
+    def close(self) -> None:
+        """Shut the mailbox: every current and future ``wait`` returns
+        True immediately so the loop re-checks its cancellation flag.
+        Idempotent; immune to the notify/park race by stickiness."""
         with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def wait(self, timeout: typing.Optional[float]) -> bool:
+        """Park until a notify / ``close`` or ``timeout`` seconds (None =
+        until signalled).  Returns True when woken by a signal (or the
+        mailbox is closed), False on timeout.  All pending signals are
+        drained in one wait — the loop re-examines every event source
+        each iteration anyway."""
+        with self._cond:
+            if self._closed:
+                return True
             if self._signals:
                 self._signals = 0
                 return True
@@ -66,4 +104,4 @@ class SourceMailbox:
                 return False
             notified = self._cond.wait(timeout)
             self._signals = 0
-            return notified
+            return notified or self._closed

@@ -142,6 +142,16 @@ class SplitSourceOperator(Operator):
     def record_emitted(self) -> None:
         self.offset += 1
 
+    def pending_alignments(self) -> typing.List[int]:
+        """Frozen alignments this reader still owes a barrier to, IF it
+        is parked split-less (a reader with no split cannot advance its
+        offset toward a count-based trigger position — the runtime cuts
+        these barriers at the wait point to break the freeze deadlock).
+        Mid-split readers return [] — their own trigger will come."""
+        if self.coordinator is None or self.current_split is not None:
+            return []
+        return self.coordinator.pending_alignments(self.reader_index)
+
     def process_record(self, record):  # pragma: no cover - sources have no input
         raise RuntimeError("SplitSourceOperator has no input")
 

@@ -7,24 +7,66 @@ The contracts under test:
 - barrier stash/replay preserves per-channel order and loses nothing
   under randomized block/unblock cycles,
 - close() unblocks stuck writers promptly.
+
+Every interleaving runs twice: once on the plain production gate, and
+once under ``FLINK_TPU_SANITIZE=1`` with a sanitizer-instrumented gate
+(PR 5) — the same properties must hold AND the happens-before recorder
+must report zero violations (no lock-order inversion, no delivery past
+a blocked channel) across the full randomized schedule.
 """
 
 import random
 import threading
 import time
 
+import pytest
+
 from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core import sanitizer_rt
 from flink_tensorflow_tpu.core.channels import ChannelWriter, InputGate
+from flink_tensorflow_tpu.core.sanitizer_rt import ConcurrencySanitizer
 
 
 def _rec(v):
     return el.StreamRecord(v, None)
 
 
+def _plain_gate(n_channels, capacity=1024):
+    return InputGate(n_channels, capacity=capacity)
+
+
+class _SanitizedGateFactory:
+    """Builds gates sharing one sanitizer so the whole test's lock
+    traffic lands in a single happens-before record."""
+
+    def __init__(self):
+        self.san = ConcurrencySanitizer("channels-stress")
+
+    def __call__(self, n_channels, capacity=1024):
+        return InputGate(n_channels, capacity=capacity, sanitizer=self.san,
+                         name=f"stress-gate[{n_channels}]")
+
+    def assert_clean(self):
+        assert self.san.violations == [], [
+            v.format() for v in self.san.violations]
+
+
+@pytest.fixture(params=["plain", "sanitized"])
+def gate_factory(request, monkeypatch):
+    if request.param == "plain":
+        yield _plain_gate
+        return
+    monkeypatch.setenv("FLINK_TPU_SANITIZE", "1")
+    assert sanitizer_rt.env_enabled()
+    factory = _SanitizedGateFactory()
+    yield factory
+    factory.assert_clean()
+
+
 class TestMultiProducerFifo:
-    def test_per_channel_order_under_concurrency(self):
+    def test_per_channel_order_under_concurrency(self, gate_factory):
         n_channels, per_channel = 8, 2000
-        gate = InputGate(n_channels, capacity=64)  # small: forces contention
+        gate = gate_factory(n_channels, capacity=64)  # small: forces contention
 
         def producer(idx):
             w = ChannelWriter(gate, idx)
@@ -49,8 +91,8 @@ class TestMultiProducerFifo:
             # FIFO per channel: exactly 0..per_channel-1 in order.
             assert seen[c] == list(range(per_channel))
 
-    def test_backpressure_blocks_writer_without_loss(self):
-        gate = InputGate(1, capacity=4)
+    def test_backpressure_blocks_writer_without_loss(self, gate_factory):
+        gate = gate_factory(1, capacity=4)
         w = ChannelWriter(gate, 0)
         n = 200
         done = threading.Event()
@@ -75,14 +117,14 @@ class TestMultiProducerFifo:
 
 
 class TestBarrierStashReplay:
-    def test_randomized_block_unblock_preserves_order(self):
+    def test_randomized_block_unblock_preserves_order(self, gate_factory):
         """Property: under arbitrary block/unblock cycles, the reader
         still observes every channel's elements exactly once, in
         per-channel FIFO order, and never sees a blocked channel's
         element while it is blocked."""
         rng = random.Random(42)
         n_channels, per_channel = 4, 500
-        gate = InputGate(n_channels, capacity=32)
+        gate = gate_factory(n_channels, capacity=32)
 
         def producer(idx):
             w = ChannelWriter(gate, idx)
@@ -125,8 +167,8 @@ class TestBarrierStashReplay:
         for c in range(n_channels):
             assert seen[c] == list(range(per_channel)), f"channel {c} disordered"
 
-    def test_stash_respects_reblock_between_cycles(self):
-        gate = InputGate(2, capacity=16)
+    def test_stash_respects_reblock_between_cycles(self, gate_factory):
+        gate = gate_factory(2, capacity=16)
         w0, w1 = ChannelWriter(gate, 0), ChannelWriter(gate, 1)
         gate.block_channel(0)
         w0.write(_rec("a0"))
@@ -144,8 +186,8 @@ class TestBarrierStashReplay:
 
 
 class TestClose:
-    def test_close_releases_blocked_writers(self):
-        gate = InputGate(1, capacity=1)
+    def test_close_releases_blocked_writers(self, gate_factory):
+        gate = gate_factory(1, capacity=1)
         w = ChannelWriter(gate, 0)
         w.write(_rec(0))  # fills capacity
         finished = threading.Event()

@@ -62,6 +62,27 @@ def test_examples_plan_has_no_error_diagnostics(pipeline):
     assert errors == [], format_diagnostics(diags)
 
 
+@pytest.mark.parametrize("pipeline", EXAMPLES + ["examples/split_source_pipeline.py"])
+def test_examples_have_zero_purity_lint_errors(pipeline):
+    """Tier-1 replay-purity gate (PR 5): no example's USER code may read
+    the wall clock, draw from a process-global RNG, mutate globals, or
+    do I/O inside a keyed-state path — the impurities that silently
+    break deterministic replay after restore.  WARNs are allowed (the
+    lint is advisory off keyed paths); ERRORs never."""
+    from flink_tensorflow_tpu.analysis import (
+        Severity,
+        analyze,
+        capture_pipeline_file,
+        format_diagnostics,
+    )
+
+    env = capture_pipeline_file(str(REPO / pipeline))
+    diags = [d for d in analyze(env.graph, config=env.config)
+             if d.rule == "replay-purity"]
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    assert errors == [], format_diagnostics(diags)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("pipeline", EXAMPLES)
 def test_examples_inspect_clean(pipeline):

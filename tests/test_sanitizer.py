@@ -1,0 +1,563 @@
+"""Pipeline sanitizer tests (PR 5).
+
+Two halves under test:
+
+- ``core/sanitizer_rt``: the debug-mode concurrency sanitizer — the
+  three SEEDED-BUG fixtures (lock-order inversion, lost wakeup,
+  barrier-alignment violation) must each be *caught*, the waits-for
+  deadlock detector must break a real cycle instead of hanging, the
+  protocol state machines must accept the healthy runtime, and a full
+  sanitized job must report zero violations.
+- ``analysis/sanitizer`` + the ``replay-purity`` /
+  ``legacy-source-timer-chain`` lint rules: the bytecode purity matrix
+  (wall clock, unseeded RNG, global mutation, mutable closure, I/O;
+  ERROR on keyed paths, WARN elsewhere) and the PR 4 migration lint.
+
+Plus the two bugs the wiring surfaced: the SourceMailbox shutdown race
+(notify is one-shot; close is the sticky, idempotent signal) and the
+split-assignment FREEZE DEADLOCK (a split-less reader parked on the
+freeze can never reach its count-based trigger position).
+"""
+
+import random
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, ".")
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.analysis import Severity, analyze
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.channels import ChannelWriter, InputGate
+from flink_tensorflow_tpu.core.sanitizer_rt import (
+    ConcurrencySanitizer,
+    SanitizerError,
+    env_enabled,
+)
+from flink_tensorflow_tpu.sources import ReplaySplitSource
+from flink_tensorflow_tpu.sources.coordinator import (
+    ASSIGNED,
+    WAIT,
+    SplitCoordinator,
+)
+from flink_tensorflow_tpu.sources.mailbox import SourceMailbox
+
+
+def _kinds(san):
+    return [v.kind for v in san.violations]
+
+
+# ---------------------------------------------------------------------------
+# Seeded bug 1/3: lock-order inversion.
+# ---------------------------------------------------------------------------
+
+
+class TestLockOrder:
+    def test_seeded_inversion_is_caught(self):
+        san = ConcurrencySanitizer("t")
+        a, b = san.lock("A"), san.lock("B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():  # the seeded bug: opposite order on another thread
+            with b:
+                with a:
+                    pass
+
+        for body in (ab, ba):  # sequential: no actual deadlock, only order
+            t = threading.Thread(target=body)
+            t.start()
+            t.join(5.0)
+        assert "lock-order-inversion" in _kinds(san)
+        with pytest.raises(SanitizerError):
+            san.check()
+
+    def test_consistent_order_is_clean(self):
+        san = ConcurrencySanitizer("t")
+        a, b = san.lock("A"), san.lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert san.violations == []
+
+    def test_inversion_reported_once_per_pair(self):
+        san = ConcurrencySanitizer("t")
+        a, b = san.lock("A"), san.lock("B")
+        with a:
+            with b:
+                pass
+        for _ in range(5):
+            with b:
+                with a:
+                    pass
+        assert _kinds(san).count("lock-order-inversion") == 1
+
+
+# ---------------------------------------------------------------------------
+# Waits-for deadlock cycle: detected AND escaped, not hung.
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlockCycle:
+    def test_real_cycle_raises_instead_of_hanging(self):
+        san = ConcurrencySanitizer("t")
+        a, b = san.lock("A"), san.lock("B")
+        holds_a = threading.Event()
+        release_a = threading.Event()
+
+        def t1():
+            with a:
+                holds_a.set()
+                release_a.wait(10.0)
+                with b:  # blocks: main holds B
+                    pass
+
+        th = threading.Thread(target=t1, daemon=True)
+        th.start()
+        assert holds_a.wait(5.0)
+        b.acquire()
+        release_a.set()
+        # Wait until t1 is registered as blocked on B.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with san._mu:
+                if any(w[0] == "lock" and w[1] == "B"
+                       for w in san._waiting.values()):
+                    break
+            time.sleep(0.01)
+        # Closing the cycle (acquire A while holding B, A's owner blocked
+        # on B) must raise, not deadlock.
+        with pytest.raises(SanitizerError) as err:
+            a.acquire()
+        assert "waits-for cycle" in str(err.value)
+        assert "deadlock-cycle" in _kinds(san)
+        b.release()
+        th.join(5.0)
+        assert not th.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Seeded bug 2/3: lost wakeup -> stall watchdog + stack/ownership dump.
+# ---------------------------------------------------------------------------
+
+
+class TestLostWakeupWatchdog:
+    def test_seeded_lost_wakeup_is_caught_with_dump(self):
+        san = ConcurrencySanitizer("t", stall_timeout_s=0.3)
+        cond = san.condition("mbox.cond")
+        parked = threading.Event()
+
+        def buggy_wait():
+            # The seeded bug: a bare check-then-park wait that does NOT
+            # consume pending signals — the notify below lands before
+            # the park and is lost, so the thread stalls forever.
+            with cond:
+                parked.set()
+                cond.wait()  # untimed: nothing will ever wake it
+
+        with cond:
+            cond.notify()  # the wakeup that gets lost
+        th = threading.Thread(target=buggy_wait, daemon=True,
+                              name="lost-wakeup-victim")
+        th.start()
+        assert parked.wait(5.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and "stall" not in _kinds(san):
+            time.sleep(0.05)
+        assert "stall" in _kinds(san)
+        stall = next(v for v in san.violations if v.kind == "stall")
+        assert "mbox.cond" in stall.message
+        # The dump carries every thread's stack + the ownership map.
+        assert stall.dump and "state dump" in stall.dump
+        assert "buggy_wait" in stall.dump
+        san.shutdown()
+        with cond:
+            cond.notify_all()
+        th.join(5.0)
+        assert not th.is_alive()
+
+    def test_timed_waits_never_stall_flag(self):
+        san = ConcurrencySanitizer("t", stall_timeout_s=0.1)
+        cond = san.condition("c")
+        with cond:
+            cond.wait(0.4)  # timed: wakes itself, not a stall
+        time.sleep(0.3)
+        san.shutdown()
+        assert san.violations == []
+
+
+# ---------------------------------------------------------------------------
+# Seeded bug 3/3: barrier-alignment violation.
+# ---------------------------------------------------------------------------
+
+
+class _AlignmentBlindGate(InputGate):
+    """Seeded bug: ignores channel blocking — an element from a channel
+    blocked for alignment is delivered instead of stashed, overtaking
+    the checkpoint cut."""
+
+    def poll(self, timeout=None):
+        with self._not_empty:
+            if not self._queue:
+                return None
+            idx, element = self._queue.popleft()
+        if self._san is not None:
+            self._san.gate_delivered(self._san_name, idx)
+        return idx, element
+
+
+class TestBarrierAlignmentMachine:
+    def test_seeded_blocked_channel_delivery_is_caught(self):
+        san = ConcurrencySanitizer("t")
+        gate = _AlignmentBlindGate(2, sanitizer=san, name="g")
+        gate.block_channel(0)  # barrier from channel 0 seen: aligned
+        ChannelWriter(gate, 0).write(el.StreamRecord(1, None))
+        item = gate.poll(timeout=0.5)  # the bug delivers it anyway
+        assert item is not None
+        assert "barrier-blocked-channel" in _kinds(san)
+
+    def test_healthy_gate_stashes_and_stays_clean(self):
+        san = ConcurrencySanitizer("t")
+        gate = InputGate(2, sanitizer=san, name="g")
+        gate.block_channel(0)
+        ChannelWriter(gate, 0).write(el.StreamRecord(1, None))
+        ChannelWriter(gate, 1).write(el.StreamRecord(2, None))
+        idx, e = gate.poll(timeout=1.0)
+        assert (idx, e.value) == (1, 2)  # only the unblocked channel
+        gate.unblock_all()
+        idx, e = gate.poll(timeout=1.0)
+        assert (idx, e.value) == (0, 1)  # stashed element replays after
+        assert san.violations == []
+
+    def test_snapshot_order_machine(self):
+        san = ConcurrencySanitizer("t")
+        # Healthy: head-to-tail, no gaps — for two interleaved ids.
+        for pos in range(3):
+            san.chain_snapshot("op.0", 1, pos, 3)
+            san.chain_snapshot("op.0", 2, pos, 3)
+        assert san.violations == []
+        # Seeded: the chain snapshots position 2 before position 1.
+        san.chain_snapshot("op.0", 3, 0, 3)
+        san.chain_snapshot("op.0", 3, 2, 3)
+        assert "snapshot-order" in _kinds(san)
+
+
+# ---------------------------------------------------------------------------
+# Assignment-freeze invariant (split coordinator).
+# ---------------------------------------------------------------------------
+
+
+class _FreezeBlindCoordinator(SplitCoordinator):
+    """Seeded bug: dispenses splits without honoring the alignment
+    freeze — the enumerator-pool snapshot loses consistency."""
+
+    def poll_split(self, reader_index):
+        with self._lock:
+            return self._dispense_locked(reader_index)
+
+
+class TestAssignmentFreeze:
+    def test_seeded_frozen_dispense_is_caught(self):
+        san = ConcurrencySanitizer("t")
+        src = ReplaySplitSource(list(range(20)), num_splits=4)
+        coord = _FreezeBlindCoordinator(src, 2, sanitizer=san, name="replay")
+        coord.on_barrier(1, 0)  # freeze: reader 1 has not passed yet
+        status, split = coord.poll_split(1)
+        assert status == ASSIGNED and split is not None  # the bug
+        assert "assignment-freeze" in _kinds(san)
+
+    def test_healthy_coordinator_waits_and_stays_clean(self):
+        san = ConcurrencySanitizer("t")
+        src = ReplaySplitSource(list(range(20)), num_splits=4)
+        coord = SplitCoordinator(src, 2, sanitizer=san, name="replay")
+        coord.on_barrier(1, 0)
+        assert coord.poll_split(1) == (WAIT, None)
+        coord.on_barrier(1, 1)  # alignment completes, freeze lifts
+        status, _ = coord.poll_split(1)
+        assert status == ASSIGNED
+        assert san.violations == []
+
+    def test_pending_alignments_lists_unpassed_readers_only(self):
+        src = ReplaySplitSource(list(range(20)), num_splits=4)
+        coord = SplitCoordinator(src, 3)
+        coord.on_barrier(7, 0)
+        assert coord.pending_alignments(0) == []
+        assert coord.pending_alignments(1) == [7]
+        coord.on_barrier(7, 1)
+        assert coord.pending_alignments(1) == []
+        assert coord.pending_alignments(2) == [7]
+
+
+# ---------------------------------------------------------------------------
+# SourceMailbox shutdown: sticky close, idempotent notify/close.
+# ---------------------------------------------------------------------------
+
+
+class TestMailboxShutdown:
+    def test_notify_then_wait_consumes_signal(self):
+        m = SourceMailbox()
+        m.notify()
+        assert m.wait(0.0) is True
+        assert m.wait(0.01) is False
+
+    def test_close_is_sticky_and_idempotent(self):
+        m = SourceMailbox()
+        m.close()
+        m.close()  # idempotent
+        assert m.closed
+        for _ in range(3):  # every future wait returns immediately
+            assert m.wait(None) is True
+        m.notify()  # no-op after close, must not raise or re-arm
+        assert m.wait(None) is True
+
+    def test_close_releases_concurrent_untimed_waiter(self):
+        m = SourceMailbox()
+        released = threading.Event()
+
+        def waiter():
+            if m.wait(None):
+                released.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.05)  # let it park
+        m.close()
+        assert released.wait(2.0), "close() must wake a parked waiter"
+        th.join(2.0)
+
+    def test_close_notify_race_cannot_strand_waiter(self):
+        # The shutdown race close() exists for: signal, then a consumer
+        # that drains the signal BEFORE parking again must still observe
+        # shutdown on its next wait — stickiness, not a counted token.
+        m = SourceMailbox()
+        m.notify()
+        assert m.wait(0.0) is True  # drains the one-shot signal
+        m.close()
+        assert m.wait(None) is True  # would hang forever with notify()
+
+    def test_sanitized_mailbox_roundtrip(self):
+        san = ConcurrencySanitizer("t")
+        m = SourceMailbox(sanitizer=san, name="src.0.mailbox")
+        m.notify()
+        assert m.wait(0.0) is True
+        m.close()
+        assert m.wait(None) is True
+        assert san.violations == []
+
+
+# ---------------------------------------------------------------------------
+# Freeze-deadlock regression: split source + count-based checkpoints +
+# parallelism > 1 (found by the sanitizer wiring; pre-PR5 this hangs).
+# ---------------------------------------------------------------------------
+
+
+class TestFreezeDeadlockRegression:
+    @pytest.mark.parametrize("sanitize", [False, True])
+    def test_parallel_split_source_with_count_checkpoints_completes(
+            self, sanitize, tmp_path):
+        env = StreamExecutionEnvironment(parallelism=2)
+        env.configure(sanitize=sanitize)
+        env.enable_checkpointing(str(tmp_path), every_n_records=16)
+        src = ReplaySplitSource(list(range(200)), num_splits=8)
+        out = (env.from_source(src, name="replay", parallelism=2)
+               .map(lambda v: v, name="ident", parallelism=2)
+               .sink_to_list())
+        env.execute("freeze-deadlock-regression", timeout=120)
+        assert sorted(out) == list(range(200))
+        if sanitize:
+            snap = env.metric_registry.report()
+            assert snap.get("sanitizer.violations") == 0
+
+
+# ---------------------------------------------------------------------------
+# Whole-job sanitize mode: clean pipelines report zero violations.
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizedJob:
+    def test_chained_rebalance_checkpoint_job_is_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            env = StreamExecutionEnvironment(parallelism=2)
+            env.configure(sanitize=True)
+            env.enable_checkpointing(d, every_n_records=8)
+            out = (env.from_collection(list(range(64)), parallelism=1)
+                   .map(lambda v: v + 1, name="inc", parallelism=1)
+                   .rebalance()
+                   .map(lambda v: v * 2, name="dbl", parallelism=2)
+                   .sink_to_list())
+            env.execute("sanitized-job", timeout=120)
+            assert sorted(out) == sorted((v + 1) * 2 for v in range(64))
+            snap = env.metric_registry.report()
+            assert snap.get("sanitizer.violations") == 0
+            assert snap.get("sanitizer.tracked_ops", 0) > 0
+
+    def test_env_var_enables_sanitizer(self, monkeypatch):
+        monkeypatch.setenv("FLINK_TPU_SANITIZE", "1")
+        assert env_enabled()
+        from flink_tensorflow_tpu.core.runtime import LocalExecutor
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.from_collection([1, 2, 3]).sink_to_list()
+        ex = LocalExecutor(env.graph)
+        assert ex.sanitizer is not None
+
+    def test_off_by_default_no_instrumentation(self, monkeypatch):
+        monkeypatch.delenv("FLINK_TPU_SANITIZE", raising=False)
+        from flink_tensorflow_tpu.core.runtime import LocalExecutor
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.from_collection([1, 2, 3]).map(lambda v: v).sink_to_list()
+        ex = LocalExecutor(env.graph)
+        assert ex.sanitizer is None
+        for gate in ex._gates:
+            assert gate._san is None
+            assert isinstance(gate._lock, type(threading.Lock()))
+
+
+# ---------------------------------------------------------------------------
+# Replay-purity lint matrix.
+# ---------------------------------------------------------------------------
+
+
+class _ImpureKeyedFn(fn.ProcessFunction):
+    def process_element(self, value, ctx, out):
+        out.collect((value, time.time(), random.random()))
+
+
+class _IOKeyedFn(fn.ProcessFunction):
+    def process_element(self, value, ctx, out):
+        with open("/tmp/never-written", "a") as f:  # noqa: F841
+            pass
+        out.collect(value)
+
+
+_SCAN_GLOBAL = 0
+
+
+class _GlobalMutFn(fn.MapFunction):
+    def map(self, value):
+        global _SCAN_GLOBAL
+        _SCAN_GLOBAL += 1
+        return value
+
+
+def _purity_diags(env):
+    return [d for d in analyze(env.graph, config=env.config)
+            if d.rule == "replay-purity"]
+
+
+class TestReplayPurityLint:
+    def test_keyed_impurity_is_error(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1, 2, 3])
+            .key_by(lambda v: v)
+            .process(_ImpureKeyedFn(), name="keyed_impure"))
+        diags = _purity_diags(env)
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        assert errors, diags
+        assert all(d.node == "keyed_impure" for d in errors)
+        symbols = " | ".join(d.message for d in errors)
+        assert "time.time" in symbols and "random.random" in symbols
+
+    def test_keyed_io_is_error(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1, 2, 3])
+            .key_by(lambda v: v)
+            .process(_IOKeyedFn(), name="keyed_io"))
+        errors = [d for d in _purity_diags(env)
+                  if d.severity == Severity.ERROR]
+        assert errors and "open" in errors[0].message
+
+    def test_nonkeyed_impurity_is_warn_not_error(self):
+        env = StreamExecutionEnvironment()
+        env.from_collection([1, 2, 3]).map(
+            lambda v: (v, time.time()), name="wallclock_map")
+        diags = _purity_diags(env)
+        assert diags and all(d.severity == Severity.WARN for d in diags)
+        assert diags[0].node == "wallclock_map"
+
+    def test_global_mutation_flagged(self):
+        env = StreamExecutionEnvironment()
+        env.from_collection([1, 2, 3]).map(_GlobalMutFn(), name="gmut")
+        diags = _purity_diags(env)
+        assert any("global _SCAN_GLOBAL" in d.message for d in diags)
+
+    def test_mutable_closure_capture_flagged(self):
+        env = StreamExecutionEnvironment()
+        acc = []
+        env.from_collection([1, 2, 3]).map(
+            lambda v: acc.append(v) or v, name="closure_map")
+        diags = _purity_diags(env)
+        assert any("closure 'acc'" in d.message for d in diags)
+        assert all(d.severity == Severity.WARN for d in diags)
+
+    def test_pure_pipeline_is_clean(self):
+        env = StreamExecutionEnvironment()
+        (env.from_collection([1, 2, 3])
+            .map(lambda v: v * 2, name="pure")
+            .filter(lambda v: v > 2, name="flt"))
+        assert _purity_diags(env) == []
+
+    def test_seeded_rng_in_user_code_is_clean(self):
+        import numpy as np
+
+        env = StreamExecutionEnvironment()
+
+        def seeded(v):
+            rng = np.random.RandomState(0)
+            return v + float(rng.rand())
+
+        env.from_collection([1, 2, 3]).map(seeded, name="seeded")
+        assert _purity_diags(env) == []
+
+
+# ---------------------------------------------------------------------------
+# Satellite lint: legacy-source chain cut before a timer-driven member.
+# ---------------------------------------------------------------------------
+
+
+class _SumWindow(fn.WindowFunction):
+    def process_window(self, key, window, elements, out):
+        out.collect(sum(elements))
+
+
+class TestLegacySourceTimerChainLint:
+    def _diags(self, env):
+        return [d for d in analyze(env.graph, config=env.config)
+                if d.rule == "legacy-source-timer-chain"]
+
+    def test_legacy_source_before_timer_op_warns(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        (env.from_collection(list(range(32)), parallelism=1)
+            .map(lambda x: x, name="pre", parallelism=1)
+            .count_window(4, timeout_s=1.0)
+            .apply(_SumWindow(), name="timed", parallelism=1))
+        diags = self._diags(env)
+        assert len(diags) == 1
+        assert diags[0].severity == Severity.WARN
+        assert "SplitSource" in diags[0].message
+        assert diags[0].edge == "pre -> timed"
+
+    def test_split_source_head_stays_quiet(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        src = ReplaySplitSource(list(range(32)), num_splits=4)
+        (env.from_source(src, name="split", parallelism=1)
+            .count_window(4, timeout_s=1.0)
+            .apply(_SumWindow(), name="timed", parallelism=1))
+        assert self._diags(env) == []
+
+    def test_pure_count_window_stays_quiet(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        (env.from_collection(list(range(32)), parallelism=1)
+            .count_window(4)
+            .apply(_SumWindow(), name="counted", parallelism=1))
+        assert self._diags(env) == []
