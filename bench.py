@@ -72,26 +72,70 @@ def _sanitize_enabled(args) -> bool:
         "1", "true", "on", "yes")
 
 
+def _trace_enabled(args) -> bool:
+    """Span tracing on/off for this run: the --trace flag wins;
+    otherwise the FLINK_TPU_TRACE env var (1/true/on enables).  The on
+    mode is the instrumentation-cost run: per-record/per-batch spans are
+    recorded end to end and each env exports a Perfetto-loadable Chrome
+    trace; off is the production zero-cost no-op path, so the on/off
+    throughput delta prices the tracer exactly like the chaining and
+    sanitize comparison rows."""
+    if getattr(args, "trace", None) is not None:
+        return args.trace == "on"
+    return os.environ.get("FLINK_TPU_TRACE", "").lower() in (
+        "1", "true", "on", "yes")
+
+
+#: Chrome-trace files exported by this bench process (one per traced
+#: env execution, numbered in construction order).
+_TRACE_FILES: typing.List[str] = []
+
+
 def _apply_chaining(env, args):
-    env.configure(chaining=_chaining_enabled(args),
-                  sanitize=_sanitize_enabled(args))
+    cfg = dict(chaining=_chaining_enabled(args),
+               sanitize=_sanitize_enabled(args))
+    if _trace_enabled(args):
+        path = os.path.abspath(
+            f"trace_{getattr(args, '_workload', 'bench')}"
+            f"_{len(_TRACE_FILES) + 1:02d}.json")
+        _TRACE_FILES.append(path)
+        cfg.update(trace=True, trace_path=path)
+    env.configure(**cfg)
     return env
 
 
 def _chain_report(env) -> dict:
     """The JSON tail's chain attribution: the execution chain topology
-    and whether fusion / the sanitizer was on — BENCH_r06 reads these
-    next to the floor components to attribute reductions (and the
-    sanitize=on row prices the instrumentation overhead)."""
+    and whether fusion / the sanitizer / the span tracer was on —
+    BENCH_r06 reads these next to the floor components to attribute
+    reductions (and the sanitize=on / trace=on rows price the
+    instrumentation overhead)."""
     from flink_tensorflow_tpu.analysis.chaining import compute_chains
 
     plan = compute_chains(env.graph, enabled=env.config.chaining)
-    return {
+    report = {
         "chaining": "on" if env.config.chaining else "off",
         "sanitize": "on" if env.config.sanitize else "off",
+        "trace": "on" if env.config.trace else "off",
         "chains": plan.names(),
         "chained_edges": plan.chained_edge_count,
     }
+    if env.config.trace and env.config.trace_path:
+        report["trace_file"] = env.config.trace_path
+    return report
+
+
+def _trace_span_overhead_ns(samples: int = 20000) -> float:
+    """Micro-measure of one span record on the tracer's hot path
+    (ring-buffer append) — the per-event cost the trace=on row pays on
+    top of the pipeline's own work."""
+    from flink_tensorflow_tpu.tracing import Tracer
+
+    tracer = Tracer()
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        tracer.span("bench.0", "overhead_probe", 0.0, 1.0)
+    return (time.perf_counter() - t0) / samples * 1e9
 
 # Prose annotations for the machine-readable ceiling-drift code (the
 # code is the source of truth; prose is presentation only).
@@ -1994,11 +2038,18 @@ WORKLOADS = {
     "filesplit": bench_filesplit,
 }
 
+#: --workload aliases, resolved before dispatch ("all" never expands
+#: them).  `openloop` is the flagship: its open-loop latency pass is the
+#: measurement the alias names, and with --trace on that pass's env is
+#: the last trace file of the workload — the one whose h2d / compute /
+#: d2h / queue spans decompose the open-loop fetch p99.
+WORKLOAD_ALIASES = {"openloop": "inception"}
+
 
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--workload", default="inception",
-                   choices=[*WORKLOADS, "all"],
+                   choices=[*WORKLOADS, *WORKLOAD_ALIASES, "all"],
                    help="which BASELINE.json config to bench (default: the north star)")
     p.add_argument("--smoke", action="store_true", help="CPU-safe tiny run")
     p.add_argument("--records", type=int, default=None)
@@ -2037,6 +2088,16 @@ def main(argv=None):
                         "per-delivery barrier-invariant checks so the "
                         "scoreboard's overhead row is attributable; "
                         "'off' is the production zero-cost no-op path")
+    p.add_argument("--trace", choices=["on", "off"], default=None,
+                   help="end-to-end span tracing (default: off, or the "
+                        "FLINK_TPU_TRACE env var) — 'on' records "
+                        "per-record/per-batch spans (queue / h2d / "
+                        "compute / d2h / serde / wire, checkpoints, "
+                        "splits) and writes one Perfetto-loadable "
+                        "trace_<workload>_<n>.json per executed env; "
+                        "'off' is the production zero-cost no-op path, "
+                        "so the on/off rate delta is the trace_overhead "
+                        "row of the BENCH trajectory")
     p.add_argument("--open-loop-start-delay-s", type=float, default=60.0,
                    help="shift the open-loop schedule past pipeline warmup "
                         "(covers one cold XLA compile of the service bucket)")
@@ -2093,10 +2154,18 @@ def main(argv=None):
         print(json.dumps(_json_safe(digest), allow_nan=False), flush=True)
         return out
 
-    names = list(WORKLOADS) if args.workload == "all" else [args.workload]
+    names = (list(WORKLOADS) if args.workload == "all"
+             else [WORKLOAD_ALIASES.get(args.workload, args.workload)])
     outputs = []
     for name in names:
+        args._workload = name
+        files_before = len(_TRACE_FILES)
         out = _json_safe(WORKLOADS[name](args))
+        if _trace_enabled(args):
+            # Every traced env this workload executed exported its own
+            # Chrome trace — list them so the trajectory can load the
+            # decomposition behind this run's numbers.
+            out["trace_files"] = _TRACE_FILES[files_before:]
         # allow_nan=False pins the invariant: the emitted line is strict
         # RFC-8259 (jq-parsable) — _json_safe already mapped any stray
         # NaN/inf float to None, so this can only trip on a new bug.
@@ -2164,8 +2233,17 @@ def _scoreboard(outputs: list) -> dict:
         "p99_ms": flag.get("p99_record_latency_ms"),
         "chaining": flag.get("chaining"),
         "sanitize": flag.get("sanitize"),
+        "trace": flag.get("trace"),
         "full_detail": "BENCH_full.json",
     }
+    if flag.get("trace") == "on":
+        # Instrumentation-cost row: the per-span hot-path cost plus the
+        # exported trace files; the on/off VALUE delta across runs is
+        # the end-to-end overhead (tracked like chaining/sanitize).
+        sb["trace_overhead"] = {
+            "span_record_ns": round(_trace_span_overhead_ns(), 1),
+            "trace_files": len(_TRACE_FILES),
+        }
     wire, wire_pre = flag.get("wire") or {}, flag.get("wire_pre") or {}
     if wire or wire_pre:
         sb["wire_mb_s_bracket"] = [
@@ -2228,8 +2306,9 @@ def _fit_scoreboard(sb: dict, limit: int = SCOREBOARD_MAX_BYTES) -> dict:
     outgrow the driver's tail window, whatever fields future rounds
     add.  The headline metric/value/latency keys are never dropped."""
     droppable = [
-        "workloads", "mfu_sweep_batch_pct", "wire_ceiling_rps_range",
-        "resnet_train", "bottleneck", "open_loop", "wire_mb_s_bracket",
+        "trace_overhead", "workloads", "mfu_sweep_batch_pct",
+        "wire_ceiling_rps_range", "resnet_train", "bottleneck",
+        "open_loop", "wire_mb_s_bracket",
     ]
     sb = dict(sb)
     for key in droppable:

@@ -72,6 +72,11 @@ class CheckpointCoordinator:
 
             registry = MetricRegistry()
         self.metrics = registry.group("checkpoint")
+        #: Span tracer (tracing plane): checkpoint-lifecycle events land
+        #: on the job-level "checkpoint" track — trigger instants and a
+        #: span per completed checkpoint (trigger -> durable).  None on
+        #: untraced jobs (and bare-protocol executor doubles).
+        self.tracer = getattr(executor, "tracer", None)
         self._last_checkpoint_id: typing.Optional[int] = None
         self._last_size_bytes: typing.Optional[int] = None
         self.metrics.gauge("last_checkpoint_id", lambda: self._last_checkpoint_id)
@@ -182,6 +187,9 @@ class CheckpointCoordinator:
             pending = _PendingCheckpoint(cid, self.executor.total_subtasks)
             self._pending[cid] = pending
             self._seed_finished(pending)
+        if self.tracer is not None:
+            self.tracer.instant("checkpoint", "trigger",
+                                args={"checkpoint": cid})
         sources = [st for st in self.executor.subtasks if st.t.is_source]
         for st in sources:
             st.request_checkpoint(cid)
@@ -288,6 +296,15 @@ class CheckpointCoordinator:
                           chk_path: typing.Optional[str]) -> None:
         """Checkpoint bookkeeping metrics — once per completed checkpoint,
         off the record path (trigger caller or persist worker)."""
+        if self.tracer is not None:
+            # The whole checkpoint lifecycle as one span on the job
+            # track: barrier inject instants and per-subtask align /
+            # snapshot spans nest visually inside it in Perfetto.
+            self.tracer.span(
+                "checkpoint", "checkpoint", pending.created_s,
+                time.monotonic(),
+                args={"checkpoint": pending.checkpoint_id,
+                      "path": chk_path})
         self.metrics.timer("duration_s").update(
             time.monotonic() - pending.created_s)
         self.metrics.counter("completed").inc()

@@ -115,6 +115,24 @@ class JobConfig:
     #: The FLINK_TPU_SANITIZE=1 env var force-enables it without config
     #: changes; FLINK_TPU_SANITIZE_STALL_S adds the stall watchdog.
     sanitize: bool = False
+    #: End-to-end span tracing (flink_tensorflow_tpu.tracing): thread a
+    #: per-record/per-batch trace context from source admission through
+    #: chains, channels, h2d/compute/d2h, checkpoint alignment, split
+    #: lifecycle, and remote edges; spans land in per-thread ring
+    #: buffers and export as Chrome Trace Event JSON (Perfetto).  Off
+    #: (the default) is a zero-cost no-op path — one is-None test per
+    #: hook site, zero per-record allocation.  FLINK_TPU_TRACE=1
+    #: force-enables without config changes.
+    trace: bool = False
+    #: Where the Chrome trace JSON is written when the job finishes (or
+    #: fails); None keeps spans in memory only (reachable through the
+    #: executor's tracer — the flink-tpu-trace CLI path).  The
+    #: FLINK_TPU_TRACE_PATH env var overrides.
+    trace_path: typing.Optional[str] = None
+    #: Head-based sampling: admit every round(1/rate)-th record per
+    #: source subtask into the trace (deterministic given the metrics
+    #: seed — see tracing.Tracer).  1.0 traces everything.
+    trace_sample_rate: float = 1.0
     #: Sleep between source emissions — test/backpressure pacing.
     source_throttle_s: float = 0.0
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
@@ -150,6 +168,10 @@ class JobConfig:
         if self.source_throttle_s < 0:
             raise ValueError(
                 f"source_throttle_s must be >= 0, got {self.source_throttle_s}"
+            )
+        if not (0.0 < self.trace_sample_rate <= 1.0):
+            raise ValueError(
+                f"trace_sample_rate must be in (0, 1], got {self.trace_sample_rate}"
             )
         if self.device_provider is not None and not callable(self.device_provider):
             raise ValueError("device_provider must be callable (task, idx) -> device")

@@ -19,10 +19,18 @@ MAX_WATERMARK = float("inf")
 
 @dataclasses.dataclass(slots=True)
 class StreamRecord:
-    """A data record with an optional event-time timestamp."""
+    """A data record with an optional event-time timestamp.
+
+    ``trace`` carries the span tracer's per-record context
+    (tracing.TraceContext) when the job runs traced AND this record was
+    sampled at its source; None always otherwise.  It rides through
+    channel queues and pickled shuffle frames with the record, so one
+    logical record is one trace across threads and processes.
+    """
 
     value: typing.Any
     timestamp: typing.Optional[float] = None
+    trace: typing.Optional[typing.Any] = None
 
 
 @dataclasses.dataclass(slots=True, frozen=True)

@@ -68,7 +68,24 @@ class JobHandle:
             # exactly what the failure post-mortem needs).
             if self.reporter is not None:
                 self.reporter.stop()
+            self._export_trace()
         return JobResult(self.executor.metrics.report())
+
+    def _export_trace(self) -> None:
+        """Write the span tracer's Chrome trace (success AND failure
+        paths — the crash trace is the one that matters).  Best-effort:
+        a full disk must not mask the job's own outcome."""
+        tracer = getattr(self.executor, "tracer", None)
+        path = getattr(self.executor, "trace_path", None)
+        if tracer is None or not path:
+            return
+        try:
+            tracer.export(path)
+        except OSError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "trace export to %s failed", path, exc_info=True)
 
     def cancel(self) -> None:
         self.executor.cancel()
@@ -78,6 +95,7 @@ class JobHandle:
         self.executor.coordinator.wait_for_persistence(60.0)
         if self.reporter is not None:
             self.reporter.stop()
+        self._export_trace()
 
     @property
     def metrics(self) -> MetricRegistry:
@@ -316,6 +334,9 @@ class StreamExecutionEnvironment:
             max_parallelism=cfg.max_parallelism,
             chaining=cfg.chaining,
             sanitize=cfg.sanitize,
+            trace=cfg.trace,
+            trace_path=cfg.trace_path,
+            trace_sample_rate=cfg.trace_sample_rate,
         )
         if cfg.distributed is not None:
             from flink_tensorflow_tpu.core.distributed import DistributedExecutor
@@ -486,6 +507,11 @@ class StreamExecutionEnvironment:
                 cid, snapshots = read_checkpoint(restore_from, restore_checkpoint_id)
             executor.restore(snapshots, from_checkpoint_id=cid,
                              local_shard=local_shard)
+        if reporter is not None:
+            # Crash-time flush (see LocalExecutor.fail): the snapshot
+            # that explains a failure is published the moment the first
+            # subtask dies, not only at the clean-join final report.
+            executor.failure_listeners.append(reporter.flush_now)
         executor.start()
         if reporter is not None:
             reporter.start()

@@ -51,16 +51,26 @@ class Output:
     ``meter``/``stats`` are optional instrumentation hooks (wired by the
     executor): the meter marks one event per emitted record, and blocked
     write time (returned by the channel layer) accumulates into
-    ``stats.blocked_s`` — both O(1) per record."""
+    ``stats.blocked_s`` — both O(1) per record.  ``tracer`` (span
+    tracing, off by default) stamps the thread's current trace context
+    onto the outgoing record with a fresh enqueue timestamp, so the
+    downstream subtask can attribute the queue wait."""
 
-    def __init__(self, edges, meter=None, stats: typing.Optional[SubtaskStats] = None):
+    def __init__(self, edges, meter=None, stats: typing.Optional[SubtaskStats] = None,
+                 tracer=None):
         # edges: list of (partitioner, [ChannelWriter per downstream subtask])
         self._edges = edges
         self._meter = meter
         self._stats = stats
+        self._tracer = tracer
 
     def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
         record = el.StreamRecord(value, timestamp)
+        tracer = self._tracer
+        if tracer is not None:
+            tctx = tracer.current()
+            if tctx is not None:
+                record.trace = tracer.fork(tctx, time.monotonic())
         blocked = 0.0
         for partitioner, writers in self._edges:
             for idx in partitioner.select(value, len(writers)):

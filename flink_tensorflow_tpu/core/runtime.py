@@ -103,21 +103,33 @@ class ChainedOutput:
       forwards — the tail's real Output broadcasts to the next chains.
     """
 
-    __slots__ = ("_subtask", "_unit", "_records_out")
+    __slots__ = ("_subtask", "_unit", "_records_out", "_tracer")
 
-    def __init__(self, subtask: "_Subtask", unit: _ChainedUnit, records_out):
+    def __init__(self, subtask: "_Subtask", unit: _ChainedUnit, records_out,
+                 tracer=None):
         self._subtask = subtask
         self._unit = unit
         self._records_out = records_out  # upstream operator's out-meter
+        self._tracer = tracer
 
     def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
         unit = self._unit
         t0 = time.monotonic()
         unit.operator.process_record_from(0, el.StreamRecord(value, timestamp))
-        unit.latency.update(time.monotonic() - t0)
+        t1 = time.monotonic()
+        unit.latency.update(t1 - t0)
         unit.records_in.mark()
         if self._records_out is not None:
             self._records_out.mark()
+        tracer = self._tracer
+        if tracer is not None:
+            tctx = tracer.current()
+            if tctx is not None:
+                # The chained hop's processing span: inclusive of the
+                # member's own downstream emissions, like its latency
+                # timer (the chain runs synchronously).
+                tracer.span(unit.scope, "process", t0, t1,
+                            args={"trace": tctx.trace_id})
 
     def broadcast_element(self, element: el.StreamElement) -> None:
         unit = self._unit
@@ -261,34 +273,60 @@ class _Subtask:
             # with no gaps (snapshot order == stream order).
             san.chain_snapshot(self.scope, checkpoint_id,
                                self.units.index(unit), len(self.units))
+        tracer = self.executor.tracer
+        t0 = time.monotonic() if tracer is not None else 0.0
         snapshot = unit.operator.snapshot(checkpoint_id)
         self.executor.coordinator.ack(
             checkpoint_id, unit.t.name, unit.index, snapshot)
+        if tracer is not None:
+            tracer.span(unit.scope, "snapshot", t0, time.monotonic(),
+                        args={"checkpoint": checkpoint_id})
 
     # --- thread bodies ---------------------------------------------------
+    def _source_barrier(self, checkpoint_id: int) -> None:
+        """Cut a legacy source's stream at a barrier: snapshot + broadcast
+        (with a trace instant marking the injection point when traced)."""
+        tracer = self.executor.tracer
+        if tracer is not None:
+            tracer.instant(self.scope, "barrier.inject",
+                           args={"checkpoint": checkpoint_id})
+        self._snapshot_and_ack(checkpoint_id)
+        self.output.broadcast_element(el.CheckpointBarrier(checkpoint_id))
+
     def run_source(self) -> None:
         op = typing.cast(SourceOperator, self.operator)
         try:
             self._open_chain()
             throttle = self.executor.source_throttle_s
             every_n = self.executor.checkpoint_every_n
+            tracer = self.executor.tracer
             for value in op.iterate():
                 if self.executor.cancelled.is_set():
                     break
                 self._deliver_notifications()
                 for cid in self._drain_control():
-                    self._snapshot_and_ack(cid)
-                    self.output.broadcast_element(el.CheckpointBarrier(cid))
+                    self._source_barrier(cid)
                 if isinstance(value, el.SourceIdle):
                     continue  # idle heartbeat: barriers served, no record
+                if tracer is not None:
+                    # Head-based admission: the ONE sampling decision for
+                    # this record's whole trace is made here.
+                    tracer.set_current(tracer.admit(self.scope, value))
                 t_emit = time.monotonic()
                 self.output.emit(value)
                 op.record_emitted()
+                t_done = time.monotonic()
                 # Per-record emit latency: dominated by blocked-put time
                 # when downstream backpressures (the source-side signal);
                 # for a chained source it covers the fused operators'
                 # inline processing.
-                self.latency.update(time.monotonic() - t_emit)
+                self.latency.update(t_done - t_emit)
+                if tracer is not None:
+                    tctx = tracer.current()
+                    if tctx is not None:
+                        tracer.span(self.scope, "emit", t_emit, t_done,
+                                    args={"trace": tctx.trace_id})
+                        tracer.set_current(None)
                 # Count-based barriers: checkpoint k cuts the stream after
                 # this subtask's k*N-th record — a deterministic position,
                 # identical on every host running the same job (the
@@ -296,14 +334,12 @@ class _Subtask:
                 if every_n and op.offset % every_n == 0:
                     cid = op.offset // every_n
                     if self.executor.coordinator.begin_source_checkpoint(cid):
-                        self._snapshot_and_ack(cid)
-                        self.output.broadcast_element(el.CheckpointBarrier(cid))
+                        self._source_barrier(cid)
                 if throttle:
                     time.sleep(throttle)
             # Serve any barrier requests that raced with the last records.
             for cid in self._drain_control():
-                self._snapshot_and_ack(cid)
-                self.output.broadcast_element(el.CheckpointBarrier(cid))
+                self._source_barrier(cid)
             op.finish()
             self.output.broadcast_element(el.EndOfPartition())
             self._close_chain()
@@ -324,6 +360,10 @@ class _Subtask:
         if checkpoint_id in self._barriers_cut:
             return
         self._barriers_cut.add(checkpoint_id)
+        tracer = self.executor.tracer
+        if tracer is not None:
+            tracer.instant(self.scope, "barrier.inject",
+                           args={"checkpoint": checkpoint_id})
         op = typing.cast("typing.Any", self.operator)
         op.on_barrier(checkpoint_id)
         self._snapshot_and_ack(checkpoint_id)
@@ -353,6 +393,7 @@ class _Subtask:
             self._open_chain()
             throttle = executor.source_throttle_s
             every_n = executor.checkpoint_every_n
+            tracer = executor.tracer
             while not executor.cancelled.is_set():
                 self._deliver_notifications()
                 for cid in self._drain_control():
@@ -364,10 +405,19 @@ class _Subtask:
                     deadline = self._chain_next_deadline()
                 kind, payload = op.poll_next()
                 if kind == RECORD:
+                    if tracer is not None:
+                        tracer.set_current(tracer.admit(self.scope, payload))
                     t_emit = time.monotonic()
                     self.output.emit(payload)
                     op.record_emitted()
-                    self.latency.update(time.monotonic() - t_emit)
+                    t_done = time.monotonic()
+                    self.latency.update(t_done - t_emit)
+                    if tracer is not None:
+                        tctx = tracer.current()
+                        if tctx is not None:
+                            tracer.span(self.scope, "emit", t_emit, t_done,
+                                        args={"trace": tctx.trace_id})
+                            tracer.set_current(None)
                     # Count-based barriers at deterministic PER-SUBTASK
                     # positions (CheckpointCoordinator's every_n mode).
                     if every_n and op.offset % every_n == 0:
@@ -434,6 +484,7 @@ class _Subtask:
         stats = self.stats
         records_in = self.records_in
         latency = self.latency
+        tracer = self.executor.tracer
         try:
             self._open_chain()
             active = n
@@ -461,8 +512,24 @@ class _Subtask:
                     continue
                 idx, element = item
                 if isinstance(element, el.StreamRecord):
-                    op.process_record_from(self.edge_of_channel[idx], element)
-                    latency.update(time.monotonic() - now)
+                    if tracer is None:
+                        op.process_record_from(self.edge_of_channel[idx], element)
+                        latency.update(time.monotonic() - now)
+                    else:
+                        tctx = element.trace
+                        if tctx is not None:
+                            # Queue-wait span (enqueue -> this delivery)
+                            # + thread-local continuity for the chain's
+                            # downstream emissions.
+                            tracer.queue_span(self.scope, tctx, now)
+                            tracer.set_current(tctx)
+                        op.process_record_from(self.edge_of_channel[idx], element)
+                        t1 = time.monotonic()
+                        latency.update(t1 - now)
+                        if tctx is not None:
+                            tracer.span(self.scope, "process", now, t1,
+                                        args={"trace": tctx.trace_id})
+                            tracer.set_current(None)
                     records_in.mark()
                 elif isinstance(element, el.CheckpointBarrier):
                     cid = element.checkpoint_id
@@ -473,7 +540,11 @@ class _Subtask:
                     gate.block_channel(idx)
                     live = {i for i in range(n) if not eop[i]}
                     if live <= seen:
-                        self.alignment.update(now - barrier_t0.pop(cid, now))
+                        t_align = barrier_t0.pop(cid, now)
+                        self.alignment.update(now - t_align)
+                        if tracer is not None:
+                            tracer.span(self.scope, "align", t_align, now,
+                                        args={"checkpoint": cid})
                         self._snapshot_and_ack(cid)
                         self.output.broadcast_element(element)
                         del barrier_seen[cid]
@@ -485,6 +556,9 @@ class _Subtask:
                     )
                     if new_wm > current_wm:
                         current_wm = new_wm
+                        if tracer is not None:
+                            tracer.instant(self.scope, "watermark", ts=now,
+                                           args={"timestamp": current_wm})
                         op.process_watermark(el.Watermark(current_wm))
                 elif isinstance(element, el.EndOfPartition):
                     eop[idx] = True
@@ -494,7 +568,11 @@ class _Subtask:
                     for cid, seen in list(barrier_seen.items()):
                         live = {i for i in range(n) if not eop[i]}
                         if live and live <= seen:
-                            self.alignment.update(now - barrier_t0.pop(cid, now))
+                            t_align = barrier_t0.pop(cid, now)
+                            self.alignment.update(now - t_align)
+                            if tracer is not None:
+                                tracer.span(self.scope, "align", t_align, now,
+                                            args={"checkpoint": cid})
                             self._snapshot_and_ack(cid)
                             self.output.broadcast_element(el.CheckpointBarrier(cid))
                             del barrier_seen[cid]
@@ -543,7 +621,11 @@ class LocalExecutor:
         max_parallelism: int = 128,
         chaining: bool = True,
         sanitize: bool = False,
+        trace: bool = False,
+        trace_path: typing.Optional[str] = None,
+        trace_sample_rate: float = 1.0,
     ):
+        from flink_tensorflow_tpu import tracing
         from flink_tensorflow_tpu.core import sanitizer_rt
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
 
@@ -560,6 +642,34 @@ class LocalExecutor:
         )
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
+        #: Span tracer (flink_tensorflow_tpu.tracing): JobConfig.trace
+        #: or FLINK_TPU_TRACE=1 turns on per-record/per-batch span
+        #: recording across sources, chains, channels, the model
+        #: runner's h2d/compute/d2h stages, checkpoints, splits and
+        #: remote edges; None (the default) keeps the production no-op
+        #: path — one is-None test per hook site, zero allocation.
+        if trace or tracing.env_enabled():
+            self.tracer = tracing.Tracer(
+                sample_rate=tracing.env_sample_rate() or trace_sample_rate,
+                seed=self.metrics.seed,
+            )
+        else:
+            self.tracer = None
+        #: Chrome-trace export destination: written by JobHandle.wait
+        #: when the job finishes OR fails (the crash trace is the one
+        #: that matters).  None keeps spans in memory (CLI path).
+        self.trace_path = trace_path or tracing.env_trace_path()
+        if self.sanitizer is not None and self.tracer is not None:
+            # Satellite wiring: sanitizer findings (stall dumps with
+            # thread stacks + lock ownership, protocol violations) land
+            # as instants on the trace timeline, next to the spans the
+            # hang interrupted.
+            self.sanitizer.tracer = self.tracer
+        #: Zero-arg hooks fired once, at the FIRST subtask failure —
+        #: the reporter thread flushes a crash-time snapshot here so the
+        #: metrics that explain the failure are published even if the
+        #: caller never joins.
+        self.failure_listeners: typing.List[typing.Callable[[], None]] = []
         self.device_provider = device_provider
         self.mesh = mesh
         self.job_config = job_config or {}
@@ -735,13 +845,15 @@ class LocalExecutor:
                 tail_grp = self.metrics.group(tail_unit.scope)
                 tail_unit.output = Output(edges_for_output,
                                           meter=tail_grp.meter("records_out"),
-                                          stats=st.stats)
+                                          stats=st.stats,
+                                          tracer=self.tracer)
                 for k in range(len(st.units) - 2, -1, -1):
                     unit = st.units[k]
                     nxt = st.units[k + 1]
                     grp_k = self.metrics.group(unit.scope)
                     unit.output = ChainedOutput(
-                        st, nxt, grp_k.meter("records_out"))
+                        st, nxt, grp_k.meter("records_out"),
+                        tracer=self.tracer)
 
                 self._wire_units(st, gates)
         # Register per-edge record-plane gauges after wiring (the gate
@@ -811,6 +923,10 @@ class LocalExecutor:
                 process_index=proc_idx,
                 num_processes=num_procs,
             )
+            # Span tracer hand-off: model runners / remote sinks read
+            # ctx.tracer at open() and record their stage spans
+            # (h2d/compute/d2h, serde/wire) on this unit's track.
+            ctx.tracer = self.tracer
             if head_gate is not None:
                 # Operator-owned background threads (the model runner's
                 # fetch thread) use this to break the CHAIN's event wait
@@ -1059,10 +1175,26 @@ class LocalExecutor:
     # --- failure / teardown ----------------------------------------------
     def fail(self, subtask: _Subtask, exc: BaseException) -> None:
         with self._error_lock:
-            if self._error is None:
+            first = self._error is None
+            if first:
                 self._error = exc
         logger.error("subtask %s failed", subtask.scope, exc_info=exc)
         self.cancel()
+        if first:
+            if self.tracer is not None:
+                self.tracer.instant(
+                    "job", "failure",
+                    args={"subtask": subtask.scope, "error": repr(exc)})
+            # Crash-time observability: flush the reporter (and any other
+            # registered listener) NOW, while the gauges still show the
+            # state that produced the failure — the final stop() flush
+            # runs after teardown and may be too late or never (a caller
+            # that crashes before join()).
+            for hook in self.failure_listeners:
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001 - observability only
+                    logger.warning("failure listener failed", exc_info=True)
 
     def cancel(self) -> None:
         self.cancelled.set()

@@ -54,6 +54,13 @@ class RuntimeContext:
         #: doesn't wait out the poll interval.  None for source subtasks
         #: (no input gate) and bare-function tests.
         self.wakeup: typing.Optional[typing.Callable[[], None]] = None
+        #: Span tracer (flink_tensorflow_tpu.tracing.Tracer) when the
+        #: job runs traced; None (the default) is the zero-cost off
+        #: path.  Operators/functions with internal stages (the model
+        #: runner's h2d/compute/d2h, remote sinks' serde/wire) record
+        #: their spans through this on the ``task_name.subtask_index``
+        #: track.
+        self.tracer: typing.Optional[typing.Any] = None
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)

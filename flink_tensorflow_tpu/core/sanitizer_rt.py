@@ -226,6 +226,12 @@ class ConcurrencySanitizer:
         )
         self.raise_on_cycle = raise_on_cycle
         self.violations: typing.List[Violation] = []
+        #: Span tracer (tracing plane), wired by the executor when BOTH
+        #: planes are on: every recorded violation — notably the stall
+        #: watchdog's dump with all thread stacks + lock ownership —
+        #: lands as an instant on the "sanitizer" trace track, so a hang
+        #: is visible in Perfetto next to the spans it interrupted.
+        self.tracer: typing.Optional[typing.Any] = None
         self._mu = threading.Lock()
         #: lock name -> owning thread id (while held).
         self._owner: typing.Dict[str, int] = {}
@@ -407,6 +413,13 @@ class ConcurrencySanitizer:
         self.violations.append(v)
         logger.error("sanitizer violation %s%s", v.format(),
                      f"\n{v.dump}" if v.dump else "")
+        if self.tracer is not None:
+            # Timeline marker: the tracer writes to the CALLING thread's
+            # own ring (no lock), so recording under self._mu is safe.
+            args = {"message": v.message, "thread": v.thread}
+            if v.dump:
+                args["dump"] = v.dump
+            self.tracer.instant("sanitizer", v.kind, args=args)
 
     def check(self) -> None:
         """Raise :class:`SanitizerError` if any violation was recorded."""

@@ -130,6 +130,12 @@ class CompiledMethodRunner:
         #: Fed to latency-budget triggers (AdaptiveLatencyTrigger
         #: reserves this much of the budget for service).
         self.service_ewma_s: typing.Optional[float] = None
+        #: Span tracer + track (from ctx at open): per-batch stage spans
+        #: lane_wait / h2d / compute / d2h — the decomposition the
+        #: latency-attribution profiler folds into its table.  None =
+        #: untraced (production no-op path).
+        self._tracer = None
+        self._trace_track: typing.Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, ctx: typing.Optional["RuntimeContext"] = None) -> None:
@@ -181,12 +187,18 @@ class CompiledMethodRunner:
             self._fetcher.start()
         if ctx is not None:
             self._metrics = ctx.metrics
+            self._tracer = getattr(ctx, "tracer", None)
+            if self._tracer is not None:
+                # Track name computed only on the traced path — bare
+                # test contexts carry metrics but no task identity.
+                self._trace_track = f"{ctx.task_name}.{ctx.subtask_index}"
 
     def warmup(self, batch_sizes: typing.Iterable[int], length_bucket: int = 128) -> None:
         """Pre-compile executables for the given batch buckets (open-time,
         so the first live window doesn't pay the 20-40s XLA compile)."""
         import numpy as np
 
+        batch_sizes = tuple(batch_sizes)
         schema = self.method.input_schema
         shapes = schema.resolve_dynamic(length_bucket)
         # Warmup batches pay the XLA compile inside the dispatch interval;
@@ -195,13 +207,24 @@ class CompiledMethodRunner:
         # the service-time EWMA (a compile-contaminated estimate would
         # make the latency-budget trigger reserve seconds it never needs).
         metrics, self._metrics = self._metrics, None
+        tracer, self._tracer = self._tracer, None
+        t_warm = time.monotonic()
         try:
             for b in batch_sizes:
                 fields = {n: np.zeros(shapes[n], schema[n].dtype) for n in schema.names}
                 self.run_batch([TensorValue(fields)] * b)
         finally:
             self._metrics = metrics
+            self._tracer = tracer
             self.service_ewma_s = None
+            if tracer is not None:
+                # One span for the whole warmup (per-stage spans are
+                # suppressed above for the same reason as the metrics:
+                # compile time must not masquerade as steady-state
+                # h2d/compute cost).
+                tracer.span(self._trace_track, "jit_warmup_compile",
+                            t_warm, time.monotonic(),
+                            args={"batches": list(batch_sizes)})
 
     def close(self) -> None:
         # Drain dispatched work through the fetch thread before dropping
@@ -405,6 +428,26 @@ class CompiledMethodRunner:
             dt if self.service_ewma_s is None
             else 0.75 * self.service_ewma_s + 0.25 * dt
         )
+        tracer = self._tracer
+        if tracer is not None:
+            # Per-batch stage spans on this operator's track — the
+            # boundaries tile t0..t_done exactly (same cuts as the
+            # __stages__ stamps below): lane-pool queueing, assemble +
+            # host->device wire + jit launch, launch -> fetch reached
+            # (device compute, overlapped with earlier fetches), and the
+            # batch's own d2h round trip.
+            track = self._trace_track
+            n = len(results)
+            tracer.span(track, "lane_wait", timings["t0"],
+                        timings["t_lane_start"], args={"batch": n})
+            tracer.span(track, "h2d", timings["t_lane_start"],
+                        timings["t_dispatched"],
+                        args={"bytes": timings["h2d_bytes"], "batch": n,
+                              "assemble_s": round(timings["assemble_s"], 6)})
+            tracer.span(track, "compute", timings["t_dispatched"],
+                        t_fetch_start, args={"batch": n})
+            tracer.span(track, "d2h", t_fetch_start, t_done,
+                        args={"batch": n})
         if self.stamp_stages:
             stages = {
                 "t0": timings["t0"],

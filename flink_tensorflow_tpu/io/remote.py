@@ -35,12 +35,17 @@ class RemoteSink(fn.SinkFunction):
         self.port = port
         self.connect_timeout_s = connect_timeout_s
         self._sock: typing.Optional[socket.socket] = None
+        self._tracer = None
+        self._track: typing.Optional[str] = None
 
     def clone(self):
         return RemoteSink(self.host, self.port, connect_timeout_s=self.connect_timeout_s)
 
     def open(self, ctx) -> None:
         import time
+
+        self._tracer = getattr(ctx, "tracer", None)
+        self._track = f"{ctx.task_name}.{ctx.subtask_index}"
 
         # Retry refused connections until the deadline: in a cohort the
         # peer's listener may come up after this job starts (process
@@ -66,8 +71,30 @@ class RemoteSink(fn.SinkFunction):
     def invoke(self, value) -> None:
         if not isinstance(value, TensorValue):
             raise TypeError("RemoteSink carries TensorValue records")
+        tracer = self._tracer
+        if tracer is None:
+            payload = encode_record(value)
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+            return
+        # Traced path: the record's trace id rides the frame header
+        # (TensorValue metadata encodes with the record), so the
+        # receiving RemoteSource re-admits it under the SAME trace —
+        # one logical record, one trace, across the job boundary.
+        tctx = tracer.current()
+        if tctx is not None:
+            value = value.with_meta(__trace__=tctx.trace_id)
+        import time
+
+        t0 = time.monotonic()
         payload = encode_record(value)
+        t1 = time.monotonic()
         self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        t2 = time.monotonic()
+        if tctx is not None:
+            tracer.span(self._track, "serde", t0, t1,
+                        args={"bytes": len(payload), "trace": tctx.trace_id})
+            tracer.span(self._track, "wire", t1, t2,
+                        args={"bytes": len(payload), "trace": tctx.trace_id})
 
     def close(self) -> None:
         if self._sock is not None:
@@ -79,10 +106,13 @@ class RemoteSink(fn.SinkFunction):
             self._sock = None
 
 
-def _read_frames(conn) -> typing.Iterator[TensorValue]:
+def _read_frames(conn, tracer=None, track=None) -> typing.Iterator[TensorValue]:
     """Decode length-prefixed frames off one connection; raises on
     truncation (EOF mid-frame = peer died mid-send; a silent stop would
-    pass truncation off as a clean close)."""
+    pass truncation off as a clean close).  With a span ``tracer``, each
+    frame's decode cost lands as a "serde" span on ``track``."""
+    import time
+
     buf = b""
 
     def read_exact(n: int, *, mid_frame: bool) -> typing.Optional[bytes]:
@@ -105,7 +135,14 @@ def _read_frames(conn) -> typing.Iterator[TensorValue]:
             return  # clean shutdown between frames
         (length,) = _LEN.unpack(head)
         payload = read_exact(length, mid_frame=True)
-        yield decode_record(payload)
+        if tracer is None:
+            yield decode_record(payload)
+        else:
+            t0 = time.monotonic()
+            record = decode_record(payload)
+            tracer.span(track, "serde", t0, time.monotonic(),
+                        args={"bytes": length})
+            yield record
 
 
 class RemoteSource(fn.SourceFunction):
@@ -137,11 +174,15 @@ class RemoteSource(fn.SourceFunction):
         self.fan_in = fan_in
         self.accept_timeout_s = accept_timeout_s
         self.queue_capacity = queue_capacity
+        self._tracer = None
+        self._track: typing.Optional[str] = None
 
     def clone(self):
         return self  # the listener is the identity; parallelism must be 1
 
     def open(self, ctx) -> None:
+        self._tracer = getattr(ctx, "tracer", None)
+        self._track = f"{ctx.task_name}.{ctx.subtask_index}"
         if ctx.parallelism != 1:
             raise RuntimeError(
                 "RemoteSource owns one listener — run it with "
@@ -178,7 +219,7 @@ class RemoteSource(fn.SourceFunction):
 
         def reader(conn):
             try:
-                for record in _read_frames(conn):
+                for record in _read_frames(conn, self._tracer, self._track):
                     if not put(record):
                         return
                 put(_EOS)

@@ -23,6 +23,7 @@ from flink_tensorflow_tpu.metrics.registry import (
 from flink_tensorflow_tpu.metrics.reporters import (
     ConsoleReporter,
     JsonLinesReporter,
+    LatestSnapshotReporter,
     MetricConfig,
     MetricReporter,
     PrometheusFileReporter,
@@ -35,6 +36,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "JsonLinesReporter",
+    "LatestSnapshotReporter",
     "Meter",
     "MetricConfig",
     "MetricGroup",

@@ -164,6 +164,121 @@ def inspect_pipeline(
     }
 
 
+def build_live_rows(snapshot: typing.Dict[str, typing.Dict[str, typing.Any]]) -> typing.List[Row]:
+    """One row per operator subtask from a single reporter snapshot —
+    the live view's per-frame fold.  Rates are the meters' WINDOW rates
+    (events/sec since the previous report; the reporter thread owns the
+    window cadence), so each frame shows current throughput, not the
+    lifetime average."""
+    rows: typing.List[Row] = []
+    for scope in sorted(snapshot):
+        task, index = _split_scope(scope)
+        if index is None or task in _JOB_SCOPES:
+            continue
+        m = snapshot[scope]
+        rec_in = m.get("records_in") or {}
+        rec_out = m.get("records_out") or {}
+        rows.append({
+            "operator": task,
+            "subtask": index,
+            "records_in": rec_in.get("count", 0),
+            "in_per_s": _finite(rec_in.get("window_rate")),
+            "out_per_s": _finite(rec_out.get("window_rate")),
+            "queue_depth": m.get("queue_depth") or 0,
+            "queue_high_watermark": m.get("queue_high_watermark") or 0,
+            "backpressure_s": _finite(m.get("backpressure_s")) or 0.0,
+            "idle_s": _finite(m.get("idle_s")),
+            "watermark_lag_s": _finite(m.get("watermark_lag_s")),
+            "splits_completed": m.get("splits_completed"),
+        })
+    return rows
+
+
+def format_live_table(rows: typing.Sequence[Row]) -> str:
+    header = ["operator", "in", "in/s", "out/s", "queue", "q.hwm",
+              "bp s", "idle s", "wm lag s"]
+    body = [[
+        f"{r['operator']}.{r['subtask']}",
+        _fmt(r["records_in"]),
+        _fmt(r["in_per_s"], digits=1),
+        _fmt(r["out_per_s"], digits=1),
+        _fmt(r["queue_depth"]),
+        _fmt(r["queue_high_watermark"]),
+        _fmt(r["backpressure_s"], digits=2),
+        _fmt(r["idle_s"], digits=2),
+        _fmt(r["watermark_lag_s"], digits=3),
+    ] for r in rows]
+    widths = [max(len(h), *(len(b[i]) for b in body)) if body else len(h)
+              for i, h in enumerate(header)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]
+    lines.append("  ".join("-" * w for w in widths))
+    for b in body:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(b, widths)))
+    return "\n".join(lines)
+
+
+def live_inspect(
+    path: str,
+    job_args: typing.Sequence[str] = ("--smoke", "--cpu"),
+    *,
+    interval_s: float = 1.0,
+    stream: typing.Optional[typing.TextIO] = None,
+    max_frames: typing.Optional[int] = None,
+    timeout_s: float = 600.0,
+) -> typing.Dict[str, typing.Any]:
+    """``flink-tpu-inspect --live``: run the pipeline with a reporter
+    thread attached and render a top-style per-operator frame each
+    interval, polling the reporter stream (a
+    :class:`~flink_tensorflow_tpu.metrics.reporters.
+    LatestSnapshotReporter` sink) — the first in-repo consumer of the
+    runtime gauges.  Returns the final job snapshot (same shape as
+    :func:`inspect_pipeline`)."""
+    from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
+    from flink_tensorflow_tpu.metrics.reporters import LatestSnapshotReporter
+
+    out = stream or sys.stdout
+    env = capture_pipeline_file(path, job_args)
+    latest = LatestSnapshotReporter()
+    env.configure(metrics=dataclasses.replace(
+        env.config.metrics,
+        report_interval_s=interval_s,
+        reporters=(*env.config.metrics.reporters, latest),
+    ))
+    t0 = time.monotonic()
+    handle = env.execute_async("inspect-live")
+    done = handle.executor._all_done
+    frames = 0
+    clear = "\x1b[2J\x1b[H" if getattr(out, "isatty", lambda: False)() else ""
+    try:
+        while True:
+            finished = done.wait(interval_s)
+            report = latest.latest()
+            if report is not None:
+                ts, snapshot = report
+                stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+                frames += 1
+                print(f"{clear}== {path} [live {stamp}, frame {frames}, "
+                      f"{time.monotonic() - t0:.1f}s] ==", file=out)
+                print(format_live_table(build_live_rows(snapshot)), file=out)
+                out.flush()
+            if finished or (max_frames is not None and frames >= max_frames):
+                break
+            if time.monotonic() - t0 > timeout_s:
+                break
+    finally:
+        handle.executor.cancel()
+        handle.wait(timeout=timeout_s)
+    wall_s = time.monotonic() - t0
+    tree = env.metric_registry.snapshot()
+    return {
+        "pipeline": path,
+        "wall_s": wall_s,
+        "frames": frames,
+        "subtasks": build_rows(tree, wall_s),
+        "job": {scope: tree[scope] for scope in _JOB_SCOPES if scope in tree},
+    }
+
+
 def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m flink_tensorflow_tpu.metrics",
@@ -188,23 +303,37 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                         help="job execution timeout in seconds")
     parser.add_argument("--snapshot-only", action="store_true",
                         help="emit only the machine-readable JSON snapshot")
+    parser.add_argument("--live", action="store_true",
+                        help="top-style live view: render a per-operator "
+                             "frame (records/s, queue depth, backpressure, "
+                             "watermark lag) each interval while the job "
+                             "runs, polling the reporter stream")
+    parser.add_argument("--live-interval", type=float, default=1.0,
+                        help="live-view frame period in seconds (default 1.0)")
     args = parser.parse_args(argv)
 
     exit_code = 0
     for path in args.pipelines:
         try:
-            snap = inspect_pipeline(
-                path, args.job_args.split(),
-                report_interval_s=args.interval,
-                jsonl_path=args.jsonl,
-                prometheus_path=args.prometheus,
-                timeout_s=args.timeout,
-            )
+            if args.live:
+                snap = live_inspect(
+                    path, args.job_args.split(),
+                    interval_s=args.live_interval,
+                    timeout_s=args.timeout,
+                )
+            else:
+                snap = inspect_pipeline(
+                    path, args.job_args.split(),
+                    report_interval_s=args.interval,
+                    jsonl_path=args.jsonl,
+                    prometheus_path=args.prometheus,
+                    timeout_s=args.timeout,
+                )
         except Exception as ex:  # noqa: BLE001 - report and keep going
             print(f"{path}: inspection failed: {ex}", file=sys.stderr)
             exit_code = max(exit_code, 2)
             continue
-        if not args.snapshot_only:
+        if not args.snapshot_only and not args.live:
             print(f"== {path} ({snap['wall_s']:.2f}s wall, "
                   f"{len(snap['chains'])} chain(s), "
                   f"{snap['chained_edges']} fused edge(s)) ==")

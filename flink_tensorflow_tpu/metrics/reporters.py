@@ -197,6 +197,25 @@ class PrometheusHttpReporter(MetricReporter):
         self._thread.join(timeout=5.0)
 
 
+class LatestSnapshotReporter(MetricReporter):
+    """In-memory sink holding only the NEWEST report — the poll target
+    of live consumers (``flink-tpu-inspect --live`` reads it once per
+    frame).  ``latest()`` returns ``(timestamp, snapshot)`` or None
+    before the first report; the swap is a single tuple assignment, so
+    a reader sees a complete (ts, snapshot) pair, never a torn one."""
+
+    def __init__(self) -> None:
+        self._latest: typing.Optional[typing.Tuple[float, Snapshot]] = None
+        self.reports = 0
+
+    def report(self, snapshot: Snapshot, *, timestamp: float) -> None:
+        self._latest = (timestamp, snapshot)
+        self.reports += 1
+
+    def latest(self) -> typing.Optional[typing.Tuple[float, Snapshot]]:
+        return self._latest
+
+
 class ConsoleReporter(MetricReporter):
     """Human-oriented: one compact line per scope per report."""
 
@@ -328,6 +347,20 @@ class ReporterThread:
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             self._publish()
+
+    def flush_now(self) -> None:
+        """Publish one out-of-cadence report immediately (the executor's
+        crash-time flush: a job failure must not lose the snapshot that
+        explains it to a reporter interval that never elapses).  Safe
+        from any thread — sinks already tolerate concurrent reports no
+        worse than a stop() racing the interval tick."""
+        try:
+            self._publish()
+        except Exception:  # noqa: BLE001 - observability must not raise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "crash-time metric flush failed", exc_info=True)
 
     def stop(self) -> None:
         """Final report + sink close; idempotent."""

@@ -62,6 +62,12 @@ class SplitSourceOperator(Operator):
         self._split_started_s: typing.Optional[float] = None
         self.splits_completed = 0
         self._restored: typing.Optional[dict] = None
+        #: Span tracer + track (from ctx at open): split-lifecycle
+        #: events — request/assign instants, one "split.read" span per
+        #: consumed split.  None = untraced.
+        self._tracer = None
+        self._track: typing.Optional[str] = None
+        self._split_requested = False
         #: Pool snapshot staged by on_barrier for the NEXT snapshot()
         #: call (reader 0 only) — snapshot() itself has no checkpoint-id
         #: channel down to _operator_snapshot.
@@ -80,6 +86,8 @@ class SplitSourceOperator(Operator):
     def open(self) -> None:
         self.reader = self.source.create_reader(self.ctx)
         self.reader.open(self.ctx)
+        self._tracer = getattr(self.ctx, "tracer", None)
+        self._track = f"{self.ctx.task_name}.{self.ctx.subtask_index}"
         grp = self.ctx.metrics
         # Per-split observability: how work actually distributed (the
         # work-stealing evidence) and what each reader is chewing on now.
@@ -113,15 +121,25 @@ class SplitSourceOperator(Operator):
             EXHAUSTED,
         )
 
+        tracer = self._tracer
         while True:
             if self._iter is None:
                 if self.current_split is None:
+                    if tracer is not None and not self._split_requested:
+                        # First pull toward the coordinator for the NEXT
+                        # split (request -> assign -> read lifecycle).
+                        self._split_requested = True
+                        tracer.instant(self._track, "split.request")
                     status, split = self.coordinator.poll_split(self.reader_index)
                     if status == EXHAUSTED:
                         return DONE, None
                     if status != ASSIGNED:
                         return WAIT, None
                     self.current_split = split
+                    if tracer is not None:
+                        self._split_requested = False
+                        tracer.instant(self._track, "split.assign",
+                                       args={"split": split.split_id})
                 # (A restored in-flight split arrives with current_split
                 # set and no iterator — same path as a fresh assignment.)
                 self._iter = self.reader.read(self.current_split)
@@ -129,6 +147,10 @@ class SplitSourceOperator(Operator):
             try:
                 value = next(self._iter)
             except StopIteration:
+                if tracer is not None and self._split_started_s is not None:
+                    tracer.span(self._track, "split.read",
+                                self._split_started_s, time.monotonic(),
+                                args={"split": self.current_split.split_id})
                 self._iter = None
                 self.current_split = None
                 self._split_started_s = None

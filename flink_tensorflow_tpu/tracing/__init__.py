@@ -1,0 +1,36 @@
+"""End-to-end span tracing + latency attribution (Perfetto-exportable).
+
+Enable with ``JobConfig(trace=True)`` (optionally ``trace_path=...``,
+``trace_sample_rate=...``) or ``FLINK_TPU_TRACE=1`` /
+``FLINK_TPU_TRACE_PATH`` / ``FLINK_TPU_TRACE_SAMPLE``.  The CLI twin is
+``flink-tpu-trace`` (``python -m flink_tensorflow_tpu.tracing``): run a
+captured pipeline under tracing and print the per-operator stage
+attribution table.  See ``tracer.py`` for the span model and
+``attribution.py`` for the profiler.
+"""
+
+from flink_tensorflow_tpu.tracing.attribution import (
+    STAGES,
+    attribution,
+    events_from_chrome,
+    format_attribution_table,
+)
+from flink_tensorflow_tpu.tracing.tracer import (
+    TraceContext,
+    Tracer,
+    env_enabled,
+    env_sample_rate,
+    env_trace_path,
+)
+
+__all__ = [
+    "STAGES",
+    "TraceContext",
+    "Tracer",
+    "attribution",
+    "env_enabled",
+    "env_sample_rate",
+    "env_trace_path",
+    "events_from_chrome",
+    "format_attribution_table",
+]

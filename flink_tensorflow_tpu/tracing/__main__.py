@@ -1,0 +1,6 @@
+"""``python -m flink_tensorflow_tpu.tracing`` — the flink-tpu-trace CLI."""
+
+from flink_tensorflow_tpu.tracing.cli import cli
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,122 @@
+"""flink-tpu-trace — execute a pipeline under span tracing and print the
+per-operator latency-attribution table.
+
+    python -m flink_tensorflow_tpu.tracing examples/mnist_lenet.py
+    flink-tpu-trace examples/mnist_lenet.py --out lenet.trace.json
+    flink-tpu-trace --from-file lenet.trace.json   # re-attribute a capture
+
+Captures the pipeline's plan the same way the analyzer/inspector CLIs do
+(``analysis.capture``), executes it with ``trace=True``, writes the
+Chrome trace JSON (Perfetto-loadable), and prints p50/p95/p99 per stage
+(queue / h2d / compute / d2h / serde / wire) per operator plus one
+machine-readable JSON line.  Exit 0 = ran to completion; 2 = capture or
+execution failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import typing
+
+from flink_tensorflow_tpu.tracing.attribution import (
+    attribution,
+    events_from_chrome,
+    format_attribution_table,
+)
+
+
+def trace_pipeline(
+    path: str,
+    job_args: typing.Sequence[str] = ("--smoke", "--cpu"),
+    *,
+    out: typing.Optional[str] = None,
+    sample_rate: float = 1.0,
+    timeout_s: float = 600.0,
+) -> typing.Dict[str, typing.Any]:
+    """Capture ``path``'s plan, execute it traced, export the Chrome
+    trace to ``out`` (default ``<path>.trace.json``), and return the
+    attribution summary dict the CLI prints."""
+    from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
+
+    out = out or f"{path}.trace.json"
+    env = capture_pipeline_file(path, job_args)
+    env.configure(trace=True, trace_path=out, trace_sample_rate=sample_rate)
+    handle = env.execute_async("trace")
+    handle.wait(timeout_s)
+    tracer = handle.executor.tracer
+    events = tracer.events()
+    return {
+        "pipeline": path,
+        "trace_file": out,
+        "events": len(events),
+        "dropped": tracer.dropped(),
+        "sample_rate": sample_rate,
+        "attribution": attribution(events),
+    }
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="flink-tpu-trace",
+        description="Span tracer: execute a pipeline with per-batch span "
+                    "tracing, export a Perfetto-loadable Chrome trace, and "
+                    "print the per-operator stage attribution table "
+                    "(queue / h2d / compute / d2h / serde / wire).",
+    )
+    parser.add_argument("pipelines", nargs="*", metavar="pipeline.py",
+                        help="pipeline script(s) defining main(argv)")
+    parser.add_argument("--from-file", default=None, metavar="TRACE.json",
+                        help="skip execution: attribute an existing exported "
+                             "Chrome trace instead")
+    parser.add_argument("--job-args", default="--smoke --cpu",
+                        help="argv passed to each pipeline's main() "
+                             "(default: '--smoke --cpu')")
+    parser.add_argument("--out", default=None, metavar="PATH",
+                        help="Chrome trace output path "
+                             "(default: <pipeline>.trace.json)")
+    parser.add_argument("--sample", type=float, default=1.0,
+                        help="head-based trace sample rate in (0, 1] "
+                             "(default: 1.0 — every record)")
+    parser.add_argument("--timeout", type=float, default=600.0,
+                        help="job execution timeout in seconds")
+    parser.add_argument("--table-only", action="store_true",
+                        help="print only the attribution table (no JSON line)")
+    args = parser.parse_args(argv)
+
+    if args.from_file is not None:
+        with open(args.from_file) as f:
+            events = events_from_chrome(json.load(f))
+        attr = attribution(events)
+        print(format_attribution_table(attr))
+        if not args.table_only:
+            print(json.dumps({"trace_file": args.from_file,
+                              "events": len(events), "attribution": attr}))
+        return 0
+
+    if not args.pipelines:
+        parser.error("provide pipeline script(s) or --from-file")
+    exit_code = 0
+    for path in args.pipelines:
+        try:
+            summary = trace_pipeline(
+                path, args.job_args.split(),
+                out=args.out, sample_rate=args.sample,
+                timeout_s=args.timeout,
+            )
+        except Exception as ex:  # noqa: BLE001 - report and keep going
+            print(f"{path}: tracing failed: {ex}", file=sys.stderr)
+            exit_code = max(exit_code, 2)
+            continue
+        print(f"== {path} -> {summary['trace_file']} "
+              f"({summary['events']} events, {summary['dropped']} dropped) ==")
+        print(format_attribution_table(summary["attribution"]))
+        if not args.table_only:
+            print(json.dumps(summary))
+    return exit_code
+
+
+def cli() -> None:
+    """Console-script entry point (``flink-tpu-trace``)."""
+    sys.exit(main())
