@@ -86,6 +86,27 @@ def _trace_enabled(args) -> bool:
         "1", "true", "on", "yes")
 
 
+def _device_resident_enabled(args) -> bool:
+    """HBM-resident chained handoff on/off for this run: the
+    --device-resident flag wins; otherwise the FLINK_TPU_DEVICE_RESIDENT
+    env var (1/true/on enables).  The on mode elides the d2h/h2d pair on
+    fused model->model hops; off is the comparison arm that fetches every
+    batch to host per hop (the pre-r6 layout)."""
+    if getattr(args, "device_resident", None) is not None:
+        return args.device_resident == "on"
+    return os.environ.get("FLINK_TPU_DEVICE_RESIDENT", "").lower() in (
+        "1", "true", "on", "yes")
+
+
+def _wire_dtype_arg(args) -> typing.Optional[str]:
+    """Compact wire dtype for this run ("f32"/None = full width): the
+    --wire-dtype flag wins; otherwise FLINK_TPU_WIRE_DTYPE."""
+    wire = getattr(args, "wire_dtype", None)
+    if wire is None:
+        wire = os.environ.get("FLINK_TPU_WIRE_DTYPE") or None
+    return None if wire in (None, "f32") else wire
+
+
 #: Chrome-trace files exported by this bench process (one per traced
 #: env execution, numbered in construction order).
 _TRACE_FILES: typing.List[str] = []
@@ -93,7 +114,9 @@ _TRACE_FILES: typing.List[str] = []
 
 def _apply_chaining(env, args):
     cfg = dict(chaining=_chaining_enabled(args),
-               sanitize=_sanitize_enabled(args))
+               sanitize=_sanitize_enabled(args),
+               device_resident=_device_resident_enabled(args),
+               wire_dtype=_wire_dtype_arg(args))
     if _trace_enabled(args):
         path = os.path.abspath(
             f"trace_{getattr(args, '_workload', 'bench')}"
@@ -117,9 +140,20 @@ def _chain_report(env) -> dict:
         "chaining": "on" if env.config.chaining else "off",
         "sanitize": "on" if env.config.sanitize else "off",
         "trace": "on" if env.config.trace else "off",
+        "device_resident": "on" if env.config.device_resident else "off",
+        "wire_dtype": env.config.wire_dtype or "f32",
         "chains": plan.names(),
         "chained_edges": plan.chained_edge_count,
+        "device_resident_edges": len(plan.device_resident_edges),
     }
+    # Runtime evidence of the elision/narrowing (summed over operators;
+    # zero rows stay honest in the off/f32 arms): called post-execute,
+    # so the registry holds this run's counters.
+    rep = env.metric_registry.report()
+    report["fetch_elided_batches"] = sum(
+        v for k, v in rep.items() if k.endswith(".fetch_elided_batches"))
+    report["wire_bytes_saved"] = sum(
+        v for k, v in rep.items() if k.endswith(".wire_bytes_saved"))
     if env.config.trace and env.config.trace_path:
         report["trace_file"] = env.config.trace_path
     return report
@@ -2029,6 +2063,139 @@ def bench_filesplit(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# workload 7: device-resident model->model chain — HBM handoff comparison
+# ---------------------------------------------------------------------------
+
+
+def bench_deviceres(args) -> dict:
+    """Model->model chained pipeline, paced open loop, run TWICE in one
+    invocation: the ``--device-resident off`` arm fetches every batch to
+    host between the two models (two h2d + two d2h per batch), the
+    ``on`` arm hands the HBM-resident DeviceBatch straight to the second
+    model (one h2d + one d2h end to end; with ``--wire-dtype bf16`` the
+    one h2d that remains also halves its bytes).  Both arms share the
+    model, schedule, and rate, so every delta is attributable to the
+    elision.  The JSON carries per-arm e2e/fetch latency percentiles
+    plus the ``fetch_elided_batches`` / ``wire_bytes_saved`` evidence
+    rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment
+    from flink_tensorflow_tpu.functions import ModelMapFunction
+    from flink_tensorflow_tpu.io import PacedSource
+    from flink_tensorflow_tpu.models.base import Model, ModelMethod
+    from flink_tensorflow_tpu.tensors import (
+        BucketLadder,
+        RecordSchema,
+        TensorValue,
+        spec,
+    )
+
+    dim = 256 if args.smoke else 4096  # 4096 f32 = 16KB/record on the wire
+    n = args.records or (16 if args.smoke else 512)
+    rate = 200.0 if args.smoke else 400.0
+    micro = min(8, max(2, (args.batch or 8)))
+
+    schema = RecordSchema({"x": spec((dim,))})
+    rng = np.random.RandomState(7)
+    params = {"w": jnp.asarray(rng.randn(dim, dim).astype(np.float32)
+                               / np.sqrt(dim))}
+
+    def serve(p, inputs):
+        return {"x": jnp.tanh(inputs["x"] @ p["w"]) + inputs["x"]}
+
+    model = Model("resmlp", params,
+                  {"serve": ModelMethod("serve", schema, ("x",), serve)})
+    records = [
+        TensorValue({"x": rng.rand(dim).astype(np.float32)}, {"id": i})
+        for i in range(n)
+    ]
+
+    def run_arm(device_resident: bool) -> dict:
+        env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
+        env.configure(device_resident=device_resident)
+        samples = []  # (latency_s, stages or None)
+
+        def sink(record):
+            sched = record.meta.get("sched_ts")
+            if sched is not None:
+                samples.append((time.monotonic() - sched,
+                                record.meta.get("__stages__")))
+
+        (
+            env.from_source(
+                PacedSource(records, rate, jitter="poisson"),
+                name="paced", parallelism=1)
+            .map(ModelMapFunction(model, micro_batch=micro,
+                                  warmup_batches=tuple(
+                                      BucketLadder.up_to(micro).sizes),
+                                  idle_flush_s=0.002), name="model_a")
+            # The LAST model stamps stage boundaries: its `fetch` stage
+            # is the one d2h the device-resident arm still pays.
+            .map(ModelMapFunction(model, micro_batch=micro,
+                                  idle_flush_s=0.002, stamp_stages=True),
+                 name="model_b")
+            .sink_to_callable(sink)
+        )
+        t0 = time.monotonic()
+        env.execute("bench-deviceres", timeout=3600)
+        wall = time.monotonic() - t0
+        p50, p99 = _percentiles_ms([lat for lat, _ in samples])
+        fetch = [st["t_done"] - st["t_fetch_start"]
+                 for _, st in samples if st]
+        f50, f99 = _percentiles_ms(fetch)
+        rep = env.metric_registry.report()
+        arm = {
+            "device_resident": "on" if device_resident else "off",
+            "records": len(samples),
+            "offered_rate_rps": rate,
+            "achieved_rate_rps": round(len(samples) / wall, 2) if wall else None,
+            "e2e_p50_ms": p50,
+            "e2e_p99_ms": p99,
+            # model_b's own d2h round trip — the ONE fetch both arms pay.
+            "fetch_p50_ms": f50,
+            "fetch_p99_ms": f99,
+            "h2d_bytes_total": sum(
+                v for k, v in rep.items() if k.endswith(".h2d_bytes")),
+            **{k: v for k, v in _chain_report(env).items()
+               if k in ("fetch_elided_batches", "wire_bytes_saved",
+                        "device_resident_edges", "wire_dtype")},
+        }
+        return arm
+
+    off = run_arm(False)
+    on = run_arm(True)
+    drop = (
+        round((off["e2e_p50_ms"] - on["e2e_p50_ms"]) / off["e2e_p50_ms"] * 100, 1)
+        if off.get("e2e_p50_ms") and on.get("e2e_p50_ms") else None
+    )
+    h2d_cut = (
+        round(1 - on["h2d_bytes_total"] / off["h2d_bytes_total"], 3)
+        if off.get("h2d_bytes_total") else None
+    )
+    return {
+        "metric": "deviceres_e2e_p50_ms_on_arm",
+        "value": on.get("e2e_p50_ms"),
+        "unit": "ms",
+        "vs_baseline": None,
+        "chaining": "on",  # both arms run chained; the comparison is residency
+        "device_resident": "on-vs-off",
+        "wire_dtype": on.get("wire_dtype"),
+        "record_bytes": dim * 4,
+        "micro_batch": micro,
+        "arms": {"off": off, "on": on},
+        "e2e_p50_drop_pct": drop,
+        "h2d_bytes_cut_fraction": h2d_cut,
+        "fetch_elided_batches": on.get("fetch_elided_batches"),
+        "wire_bytes_saved": on.get("wire_bytes_saved"),
+        "baseline_note": (
+            "no reference counterpart: the reference fetches every batch "
+            "to the JVM between chained model ops"),
+    }
+
+
 WORKLOADS = {
     "inception": bench_inception,
     "mnist": bench_mnist,
@@ -2036,6 +2203,7 @@ WORKLOADS = {
     "widedeep": bench_widedeep,
     "resnet": bench_resnet,
     "filesplit": bench_filesplit,
+    "deviceres": bench_deviceres,
 }
 
 #: --workload aliases, resolved before dispatch ("all" never expands
@@ -2098,6 +2266,24 @@ def main(argv=None):
                         "'off' is the production zero-cost no-op path, "
                         "so the on/off rate delta is the trace_overhead "
                         "row of the BENCH trajectory")
+    p.add_argument("--device-resident", choices=["on", "off"], default=None,
+                   help="HBM-resident chained handoff (default: off, or "
+                        "the FLINK_TPU_DEVICE_RESIDENT env var) — 'on' "
+                        "elides the d2h/h2d pair on fused model->model "
+                        "hops (DeviceBatch handoff; fetch forced once at "
+                        "the first host-only consumer); 'off' is the "
+                        "comparison arm that fetches per hop.  The "
+                        "`deviceres` workload runs BOTH arms in one "
+                        "invocation regardless of this flag")
+    p.add_argument("--wire-dtype", choices=["f32", "bf16", "f16", "int8"],
+                   default=None,
+                   help="compact on-the-wire dtype (default: f32, or the "
+                        "FLINK_TPU_WIRE_DTYPE env var) — bf16/f16 halve "
+                        "every f32 field's bytes on the h2d hop (dtype "
+                        "restored inside the jitted call) and on remote "
+                        "TCP frames; int8 (absmax-quantized) applies to "
+                        "TCP frames only.  The wire_bytes_saved row "
+                        "records the evidence")
     p.add_argument("--open-loop-start-delay-s", type=float, default=60.0,
                    help="shift the open-loop schedule past pipeline warmup "
                         "(covers one cold XLA compile of the service bucket)")
@@ -2234,6 +2420,10 @@ def _scoreboard(outputs: list) -> dict:
         "chaining": flag.get("chaining"),
         "sanitize": flag.get("sanitize"),
         "trace": flag.get("trace"),
+        "device_resident": flag.get("device_resident"),
+        "wire_dtype": flag.get("wire_dtype"),
+        "fetch_elided_batches": flag.get("fetch_elided_batches"),
+        "wire_bytes_saved": flag.get("wire_bytes_saved"),
         "full_detail": "BENCH_full.json",
     }
     if flag.get("trace") == "on":
@@ -2306,7 +2496,8 @@ def _fit_scoreboard(sb: dict, limit: int = SCOREBOARD_MAX_BYTES) -> dict:
     outgrow the driver's tail window, whatever fields future rounds
     add.  The headline metric/value/latency keys are never dropped."""
     droppable = [
-        "trace_overhead", "workloads", "mfu_sweep_batch_pct",
+        "trace_overhead", "fetch_elided_batches", "wire_bytes_saved",
+        "workloads", "mfu_sweep_batch_pct",
         "wire_ceiling_rps_range", "resnet_train", "bottleneck",
         "open_loop", "wire_mb_s_bracket",
     ]

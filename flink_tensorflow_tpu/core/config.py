@@ -133,6 +133,24 @@ class JobConfig:
     #: source subtask into the trace (deterministic given the metrics
     #: seed — see tracing.Tracer).  1.0 traces everything.
     trace_sample_rate: float = 1.0
+    #: Device-resident dataflow (tensors/transfer.DeviceBatch): chains
+    #: of device-capable operators (model -> model, model -> elementwise
+    #: device map) hand HBM-resident batches between fused members — the
+    #: d2h fetch is elided until the first host-only consumer (sink,
+    #: keyed shuffle, remote edge) forces it exactly once, so a chained
+    #: hop pays the wire once per direction end to end instead of twice
+    #: per hop.  Off (the default) keeps every result on the host path.
+    #: FLINK_TPU_DEVICE_RESIDENT=1 force-enables; per-operator override
+    #: via ModelMapFunction(device_resident=True/False).
+    device_resident: bool = False
+    #: Compact on-the-wire dtype for float tensors: "bf16"/"f16" halve
+    #: the bytes of every f32 field on BOTH the h2d hop (model runners
+    #: narrow host-side; the declared dtype is restored inside the
+    #: jitted call) and remote TCP frames (tensors/serde.py restores at
+    #: decode); "int8" (absmax-quantized) applies to TCP frames only.
+    #: None/"f32" ships full width.  FLINK_TPU_WIRE_DTYPE overrides.
+    #: Accuracy caveats documented in tensors/serde.py.
+    wire_dtype: typing.Optional[str] = None
     #: Sleep between source emissions — test/backpressure pacing.
     source_throttle_s: float = 0.0
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
@@ -169,6 +187,14 @@ class JobConfig:
             raise ValueError(
                 f"source_throttle_s must be >= 0, got {self.source_throttle_s}"
             )
+        if self.wire_dtype is not None:
+            from flink_tensorflow_tpu.tensors.serde import WIRE_DTYPES
+
+            if self.wire_dtype not in WIRE_DTYPES:
+                raise ValueError(
+                    f"wire_dtype must be one of {WIRE_DTYPES} or None, "
+                    f"got {self.wire_dtype!r}"
+                )
         if not (0.0 < self.trace_sample_rate <= 1.0):
             raise ValueError(
                 f"trace_sample_rate must be in (0, 1], got {self.trace_sample_rate}"

@@ -334,6 +334,8 @@ class StreamExecutionEnvironment:
             max_parallelism=cfg.max_parallelism,
             chaining=cfg.chaining,
             sanitize=cfg.sanitize,
+            device_resident=cfg.device_resident,
+            wire_dtype=cfg.wire_dtype,
             trace=cfg.trace,
             trace_path=cfg.trace_path,
             trace_sample_rate=cfg.trace_sample_rate,

@@ -65,6 +65,16 @@ class Output:
         self._tracer = tracer
 
     def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
+        if getattr(value, "is_device_batch", False):
+            # Channel boundary = host boundary: a keyed shuffle needs
+            # per-record keys, a remote edge needs bytes, a checkpoint
+            # needs picklable elements — this is where a device-resident
+            # segment ends, so the deferred d2h forces HERE, exactly
+            # once, and the batch fans out as per-record host values.
+            ts = timestamp if timestamp is not None else value.timestamp
+            for tv in value.materialize():
+                self.emit(tv, ts)
+            return
         record = el.StreamRecord(value, timestamp)
         tracer = self._tracer
         if tracer is not None:
@@ -324,7 +334,18 @@ class MapOperator(_FunctionOperator):
     def open(self) -> None:
         if self._async:
             def emit(value, _ts):
-                ts = self._ts_fifo.popleft() if self._ts_fifo else None
+                fifo = self._ts_fifo
+                ts = fifo.popleft() if fifo else None
+                if getattr(value, "is_device_batch", False):
+                    # One emission covers num_records inputs: consume
+                    # their timestamps positionally and stamp the batch
+                    # with the OLDEST (a later materialization fans the
+                    # records out under it; watermark flushes still
+                    # precede the watermark, so event time stays safe).
+                    for _ in range(value.num_records - 1):
+                        if fifo:
+                            fifo.popleft()
+                    value.timestamp = ts
                 self.output.emit(value, ts)
 
             self._collector = fn.Collector(emit)
@@ -332,8 +353,14 @@ class MapOperator(_FunctionOperator):
 
     def process_record(self, record):
         if self._async:
-            self._ts_fifo.append(record.timestamp)
-            self.function.map_async(record.value, self._collector)
+            value = record.value
+            if getattr(value, "is_device_batch", False):
+                # One device batch fans out into num_records results —
+                # keep the positional timestamp FIFO aligned.
+                self._ts_fifo.extend([record.timestamp] * value.num_records)
+            else:
+                self._ts_fifo.append(record.timestamp)
+            self.function.map_async(value, self._collector)
         else:
             self.output.emit(self.function.map(record.value), record.timestamp)
 

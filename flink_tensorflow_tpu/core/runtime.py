@@ -103,24 +103,38 @@ class ChainedOutput:
       forwards — the tail's real Output broadcasts to the next chains.
     """
 
-    __slots__ = ("_subtask", "_unit", "_records_out", "_tracer")
+    __slots__ = ("_subtask", "_unit", "_records_out", "_tracer",
+                 "_accepts_device")
 
     def __init__(self, subtask: "_Subtask", unit: _ChainedUnit, records_out,
-                 tracer=None):
+                 tracer=None, accepts_device: bool = False):
         self._subtask = subtask
         self._unit = unit
         self._records_out = records_out  # upstream operator's out-meter
         self._tracer = tracer
+        #: Whether the downstream chained operator consumes DeviceBatch
+        #: records directly (device-resident handoff).  False = this hop
+        #: is a host boundary: a device batch materializes here (the
+        #: deferred d2h forces exactly once) and fans out per record.
+        self._accepts_device = accepts_device
 
     def emit(self, value: typing.Any, timestamp: typing.Optional[float] = None) -> None:
         unit = self._unit
+        n = 1
+        if getattr(value, "is_device_batch", False):
+            if not self._accepts_device:
+                ts = timestamp if timestamp is not None else value.timestamp
+                for tv in value.materialize():
+                    self.emit(tv, ts)
+                return
+            n = value.num_records  # meters stay per-RECORD under fusion
         t0 = time.monotonic()
         unit.operator.process_record_from(0, el.StreamRecord(value, timestamp))
         t1 = time.monotonic()
         unit.latency.update(t1 - t0)
-        unit.records_in.mark()
+        unit.records_in.mark(n)
         if self._records_out is not None:
-            self._records_out.mark()
+            self._records_out.mark(n)
         tracer = self._tracer
         if tracer is not None:
             tctx = tracer.current()
@@ -624,12 +638,30 @@ class LocalExecutor:
         trace: bool = False,
         trace_path: typing.Optional[str] = None,
         trace_sample_rate: float = 1.0,
+        device_resident: bool = False,
+        wire_dtype: typing.Optional[str] = None,
     ):
         from flink_tensorflow_tpu import tracing
         from flink_tensorflow_tpu.core import sanitizer_rt
         from flink_tensorflow_tpu.core.checkpoint import CheckpointCoordinator
+        from flink_tensorflow_tpu.tensors.transfer import (
+            env_device_resident,
+            env_wire_dtype,
+        )
 
         self.graph = graph
+        #: Device-resident dataflow (tensors/transfer.DeviceBatch):
+        #: chains of device-capable operators hand HBM-resident batches
+        #: between fused members, eliding the d2h/h2d pair per hop; the
+        #: first host-only consumer forces the fetch exactly once.
+        #: JobConfig.device_resident or FLINK_TPU_DEVICE_RESIDENT=1.
+        self.device_resident = device_resident or env_device_resident()
+        #: Job-wide compact wire dtype (h2d + remote frames); model
+        #: functions/remote sinks default to it at open().
+        #: JobConfig.wire_dtype or FLINK_TPU_WIRE_DTYPE.
+        self.wire_dtype = wire_dtype if wire_dtype is not None else env_wire_dtype()
+        if self.wire_dtype == "f32":
+            self.wire_dtype = None
         #: Debug-mode concurrency sanitizer (core/sanitizer_rt):
         #: JobConfig.sanitize=True or FLINK_TPU_SANITIZE=1 instruments
         #: every gate/mailbox/coordinator lock and asserts the barrier
@@ -851,9 +883,19 @@ class LocalExecutor:
                     unit = st.units[k]
                     nxt = st.units[k + 1]
                     grp_k = self.metrics.group(unit.scope)
+                    accepts = getattr(
+                        getattr(nxt.operator, "function", None),
+                        "accepts_device_batches", False)
                     unit.output = ChainedOutput(
                         st, nxt, grp_k.meter("records_out"),
-                        tracer=self.tracer)
+                        tracer=self.tracer, accepts_device=accepts)
+                    if accepts and self.device_resident:
+                        # Emission hint: this member's function may keep
+                        # its results HBM-resident — the next chained
+                        # operator consumes DeviceBatches directly.
+                        up_fn = getattr(unit.operator, "function", None)
+                        if getattr(up_fn, "device_capable", False):
+                            up_fn._device_chain_hint = True
 
                 self._wire_units(st, gates)
         # Register per-edge record-plane gauges after wiring (the gate
@@ -927,6 +969,10 @@ class LocalExecutor:
             # ctx.tracer at open() and record their stage spans
             # (h2d/compute/d2h, serde/wire) on this unit's track.
             ctx.tracer = self.tracer
+            # Device-residency hand-off: model functions resolve their
+            # emission mode / h2d wire dtype from these at open().
+            ctx.device_resident = self.device_resident
+            ctx.wire_dtype = self.wire_dtype
             if head_gate is not None:
                 # Operator-owned background threads (the model runner's
                 # fetch thread) use this to break the CHAIN's event wait

@@ -61,6 +61,14 @@ class RuntimeContext:
         #: their spans through this on the ``task_name.subtask_index``
         #: track.
         self.tracer: typing.Optional[typing.Any] = None
+        #: Device-resident dataflow mode (JobConfig.device_resident):
+        #: model functions consult it at open() to decide whether chained
+        #: results stay HBM-resident (DeviceBatch) instead of fetching.
+        self.device_resident: bool = False
+        #: Job-wide compact wire dtype ("bf16"/"f16"/"int8"; None = f32):
+        #: model runners narrow their h2d transfers with it, remote sinks
+        #: their TCP frames.
+        self.wire_dtype: typing.Optional[str] = None
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)

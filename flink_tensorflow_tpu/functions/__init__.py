@@ -2,6 +2,7 @@
 (BASELINE.json:5; SURVEY.md §2 row 7)."""
 
 from flink_tensorflow_tpu.functions.model_function import (
+    DeviceMapFunction,
     GraphMapFunction,
     GraphWindowFunction,
     ModelMapFunction,
@@ -15,6 +16,7 @@ from flink_tensorflow_tpu.functions.training_function import (
 
 __all__ = [
     "CompiledMethodRunner",
+    "DeviceMapFunction",
     "DPTrainWindowFunction",
     "OnlineTrainFunction",
     "GraphMapFunction",

@@ -62,6 +62,13 @@ class _ModelFunctionBase(fn.RichFunction):
     #: jitted, static-shape code (see flink_tensorflow_tpu.analysis).
     is_jit_boundary = True
 
+    #: Device-residency capability markers (analysis/chaining.py +
+    #: executor wiring): this function both PRODUCES device batches (its
+    #: runner can elide the fetch) and CONSUMES them (subclasses feed
+    #: upstream DeviceArrays straight into their jitted call).
+    device_capable = True
+    accepts_device_batches = True
+
     def __init__(
         self,
         model: ModelSource,
@@ -74,6 +81,8 @@ class _ModelFunctionBase(fn.RichFunction):
         outputs: typing.Optional[typing.Sequence[str]] = None,
         transfer_lanes: int = 1,
         stamp_stages: bool = False,
+        device_resident: typing.Optional[bool] = None,
+        wire_dtype: typing.Optional[str] = None,
     ):
         self._source = model
         self._method_name = method
@@ -86,6 +95,18 @@ class _ModelFunctionBase(fn.RichFunction):
         #: Stamp per-record stage timestamps into result metadata
         #: (``meta["__stages__"]``) for latency decomposition.
         self._stamp_stages = stamp_stages
+        #: Device-resident emission: True forces DeviceBatch output,
+        #: False forces host records, None (default) follows
+        #: JobConfig.device_resident AND the executor's chained-consumer
+        #: hint (emission only pays off when the next chained operator
+        #: actually consumes device batches).
+        self._device_resident = device_resident
+        #: Compact h2d wire dtype ("bf16"/"f16"); None follows
+        #: JobConfig.wire_dtype.
+        self._wire_dtype = wire_dtype
+        #: Set by the executor (core/runtime._wire_units) when the next
+        #: CHAINED operator declares accepts_device_batches.
+        self._device_chain_hint = False
         self.runner: typing.Optional[CompiledMethodRunner] = None
         self._out: typing.Optional[fn.Collector] = None
         self._derived_schema: typing.Any = _UNKNOWN
@@ -204,6 +225,8 @@ class _ModelFunctionBase(fn.RichFunction):
 
     def open(self, ctx) -> None:
         model = _resolve(self._source)
+        wire = (self._wire_dtype if self._wire_dtype is not None
+                else getattr(ctx, "wire_dtype", None))
         self.runner = CompiledMethodRunner(
             model,
             self._method_name,
@@ -211,9 +234,25 @@ class _ModelFunctionBase(fn.RichFunction):
             donate_inputs=self._donate,
             output_names=self._outputs,
             dispatch_lanes=self._transfer_lanes,
+            wire_dtype=wire,
         )
         self.runner.stamp_stages = self._stamp_stages
         self.runner.open(ctx)
+        # Device-resident emission: explicit kwarg wins; otherwise the
+        # job-wide mode applies only where the executor marked the next
+        # chained operator as a device-batch consumer (emitting into a
+        # host-only consumer would just move the same fetch onto the
+        # subtask thread and lose the background-fetch overlap).
+        if self._device_resident is not None:
+            self.runner.emit_device_batches = self._device_resident
+        else:
+            self.runner.emit_device_batches = bool(
+                getattr(ctx, "device_resident", False)
+                and self._device_chain_hint)
+        if self.runner.emit_device_batches and self._stamp_stages:
+            # Stage stamps ride per-record host metadata, which a
+            # device-resident batch does not materialize here.
+            self.runner.stamp_stages = False
         # Completed results wake the subtask loop immediately (instead of
         # waiting out the poll interval) when the runtime provides a
         # gate wakeup hook.
@@ -290,9 +329,23 @@ class ModelMapFunction(_ModelFunctionBase, fn.AsyncMapFunction):
 
     def map_async(self, value, out: fn.Collector):
         self._out = out
-        self._buf.append(value)
-        if len(self._buf) >= self._micro_batch:
+        if getattr(value, "is_device_batch", False):
+            # HBM-resident handoff from the upstream chained model: the
+            # batch bypasses the host micro-batch buffer entirely and
+            # feeds the jitted call as-is (no d2h upstream, no h2d
+            # here).  Flush the host buffer FIRST so emission order
+            # stays arrival order (the runner collects FIFO).
             self._dispatch_buf()
+            if not self.runner.dispatch_device(value):
+                # Schema-incompatible batch: pay the fetch at this
+                # boundary and take the host path in bucket-sized chunks.
+                records = value.materialize()
+                for i in range(0, len(records), self._micro_batch):
+                    self.runner.dispatch(records[i:i + self._micro_batch])
+        else:
+            self._buf.append(value)
+            if len(self._buf) >= self._micro_batch:
+                self._dispatch_buf()
         self._last_activity = time.monotonic()
         for record in self.runner.collect_progress(self._max_in_flight):
             out.collect(record)
@@ -401,6 +454,13 @@ class ModelWindowFunction(_ModelFunctionBase, fn.WindowFunction):
     sized ``(pipeline_depth + 2) * fixed_batch`` slots.  Default: auto
     (on when eligible); pass ``use_ring=False`` to force the list path.
     """
+
+    #: A window operator counts ELEMENTS into its buffer — one
+    #: DeviceBatch would count as one element and skew the window
+    #: semantics, so device batches materialize at the boundary before
+    #: entering a window (this function still PRODUCES device batches
+    #: when chained into a device-capable consumer).
+    accepts_device_batches = False
 
     def __init__(self, model: ModelSource, method: str = "serve", *,
                  pipeline_depth: typing.Optional[int] = None,
@@ -812,3 +872,60 @@ class GraphWindowFunction(_GraphFunctionBase, fn.WindowFunction):
         for i in range(0, len(elements), self._batch):
             for record in self._run(elements[i:i + self._batch]):
                 out.collect(record)
+
+
+class DeviceMapFunction(fn.MapFunction):
+    """Elementwise device-side map — a HBM-resident link in a chain.
+
+    Wraps a pure ``arrays -> arrays`` callable (dict of ``[B, ...]``
+    batch-major arrays in, dict out) and applies it jitted.  Fed a
+    :class:`~flink_tensorflow_tpu.tensors.transfer.DeviceBatch` (chained
+    behind a device-resident model), the whole batch transforms ON
+    DEVICE and is re-emitted as a DeviceBatch — the hop costs zero wire
+    bytes, so a model -> elementwise -> model chain stays HBM-resident
+    end to end.  Fed plain host records (unchained placement, or device
+    residency off), each record lifts to a batch of one, transforms, and
+    returns to a host ``TensorValue`` — semantics identical, only the
+    residency differs.
+
+    The callable must be replay-pure (jit traces it once); state, I/O
+    and clocks are as illegal here as inside any model method.
+    """
+
+    device_capable = True
+    accepts_device_batches = True
+
+    def __init__(self, array_fn: typing.Callable[[typing.Mapping[str, typing.Any]],
+                                                 typing.Mapping[str, typing.Any]]):
+        self._array_fn = array_fn
+        self._jit = None
+
+    def clone(self) -> "fn.Function":
+        import copy
+
+        dup = copy.copy(self)
+        dup._jit = None
+        return dup
+
+    def open(self, ctx) -> None:
+        import jax
+
+        self._jit = jax.jit(self._array_fn)
+
+    def close(self) -> None:
+        self._jit = None
+
+    def map(self, value):
+        from flink_tensorflow_tpu.tensors.transfer import DeviceBatch
+
+        if isinstance(value, DeviceBatch):
+            return DeviceBatch(self._jit(value.arrays), value.valid,
+                               value.metas, timestamp=value.timestamp,
+                               tracer=value._tracer, track=value._track)
+        if not isinstance(value, TensorValue):
+            raise TypeError(
+                f"DeviceMapFunction maps tensor records, got {type(value).__name__}")
+        lifted = {n: np.asarray(a)[None] for n, a in value.fields.items()}
+        out = self._jit(lifted)
+        return TensorValue({n: np.asarray(a)[0] for n, a in out.items()},
+                           value.meta)

@@ -12,6 +12,13 @@ to a peer; ``RemoteSource`` accepts one connection and yields records.
 Delivery is at-least-once only if the upstream replays on failure — TCP
 sources are non-replayable, so exactly-once jobs should front them with
 a durable log, exactly as Flink treats raw socket sources.
+
+Wire narrowing: ``RemoteSink(wire_dtype="bf16"|"f16"|"int8")`` ships
+floating-point field buffers in the compact on-the-wire dtype (half or
+quarter the bytes per record on the TCP frame); the receiving decode
+restores the original dtype transparently, so RemoteSource needs no
+matching flag.  Defaults to the job-wide ``JobConfig.wire_dtype`` when
+unset.  Bytes saved are counted on the ``wire_bytes_saved`` metric.
 """
 
 from __future__ import annotations
@@ -30,22 +37,37 @@ _LEN = struct.Struct("<Q")
 class RemoteSink(fn.SinkFunction):
     """Ships records (TensorValue) to a RemoteSource over TCP."""
 
-    def __init__(self, host: str, port: int, *, connect_timeout_s: float = 30.0):
+    def __init__(self, host: str, port: int, *, connect_timeout_s: float = 30.0,
+                 wire_dtype: typing.Optional[str] = None):
+        from flink_tensorflow_tpu.tensors.serde import normalize_wire_dtype
+
         self.host = host
         self.port = port
         self.connect_timeout_s = connect_timeout_s
+        #: Compact on-the-wire dtype for float fields (tensors/serde.py);
+        #: None defers to JobConfig.wire_dtype at open().
+        self.wire_dtype = normalize_wire_dtype(wire_dtype)
+        self._wire: typing.Optional[str] = self.wire_dtype
         self._sock: typing.Optional[socket.socket] = None
         self._tracer = None
         self._track: typing.Optional[str] = None
+        self._saved_counter = None
 
     def clone(self):
-        return RemoteSink(self.host, self.port, connect_timeout_s=self.connect_timeout_s)
+        return RemoteSink(self.host, self.port,
+                          connect_timeout_s=self.connect_timeout_s,
+                          wire_dtype=self.wire_dtype)
 
     def open(self, ctx) -> None:
         import time
 
         self._tracer = getattr(ctx, "tracer", None)
         self._track = f"{ctx.task_name}.{ctx.subtask_index}"
+        self._wire = (self.wire_dtype
+                      if self.wire_dtype is not None
+                      else getattr(ctx, "wire_dtype", None))
+        if self._wire is not None and ctx.metrics is not None:
+            self._saved_counter = ctx.metrics.counter("wire_bytes_saved")
 
         # Retry refused connections until the deadline: in a cohort the
         # peer's listener may come up after this job starts (process
@@ -71,9 +93,13 @@ class RemoteSink(fn.SinkFunction):
     def invoke(self, value) -> None:
         if not isinstance(value, TensorValue):
             raise TypeError("RemoteSink carries TensorValue records")
+        if self._saved_counter is not None:
+            from flink_tensorflow_tpu.tensors.serde import wire_bytes_saved
+
+            self._saved_counter.inc(wire_bytes_saved(value, self._wire))
         tracer = self._tracer
         if tracer is None:
-            payload = encode_record(value)
+            payload = encode_record(value, self._wire)
             self._sock.sendall(_LEN.pack(len(payload)) + payload)
             return
         # Traced path: the record's trace id rides the frame header
@@ -86,7 +112,7 @@ class RemoteSink(fn.SinkFunction):
         import time
 
         t0 = time.monotonic()
-        payload = encode_record(value)
+        payload = encode_record(value, self._wire)
         t1 = time.monotonic()
         self._sock.sendall(_LEN.pack(len(payload)) + payload)
         t2 = time.monotonic()

@@ -16,6 +16,21 @@ contract — numpy scalars, tuples, non-str keys all round-trip; the
 record plane is an intra-cluster trust boundary, same stance as Flink's
 Kryo).  Buffers follow in header order, tightly packed — decode is
 zero-copy (``np.frombuffer`` views over the received bytes).
+
+**Wire narrowing** (opt-in): ``encode_record(..., wire_dtype=...)``
+ships floating-point field buffers in a compact on-the-wire dtype —
+``"bf16"``/``"f16"`` halve the bytes of every f32 field, ``"int8"``
+quarters them with a per-field absmax scale — and ``decode_record``
+restores the original dtype, so the narrowing is invisible to everything
+downstream of the frame.  Narrowed field entries extend the header row
+to ``[name, shape, dtype, wire, scale]`` (``scale`` is None except for
+int8); un-narrowed fields keep the 3-element row, so ``"f32"``/None
+produces byte-identical frames to the pre-narrowing codec.  Integer,
+bool, and already-narrow fields pass through unchanged.  Accuracy
+caveat: bf16 keeps f32's range at ~3 decimal digits of mantissa, f16
+keeps ~3.3 digits but saturates beyond ±65504, int8 is a uniform
+absmax quantization (worst-case error = absmax/254 per field) — use it
+only for activations/scores that tolerate it, never for ids.
 """
 
 from __future__ import annotations
@@ -32,8 +47,68 @@ from flink_tensorflow_tpu.tensors.value import TensorValue
 MAGIC = 0x52545446  # 'FTTR'
 _HEADER = struct.Struct("<III")
 
+#: Accepted ``wire_dtype`` names.  ``"f32"`` and None both mean "ship
+#: buffers verbatim" (the identity codec).
+WIRE_DTYPES = ("f32", "bf16", "f16", "int8")
 
-def encode_record(record: TensorValue) -> bytes:
+
+def _wire_np_dtype(wire: str) -> np.dtype:
+    """The numpy dtype a narrowed buffer is laid out as on the wire."""
+    if wire == "bf16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if wire == "f16":
+        return np.dtype(np.float16)
+    if wire == "int8":
+        return np.dtype(np.int8)
+    raise ValueError(f"unknown wire dtype {wire!r} (expected one of {WIRE_DTYPES})")
+
+
+def normalize_wire_dtype(wire: typing.Optional[str]) -> typing.Optional[str]:
+    """Validate + canonicalize a wire-dtype name; ``"f32"`` -> None."""
+    if wire is None or wire == "f32":
+        return None
+    if wire not in WIRE_DTYPES:
+        raise ValueError(
+            f"unknown wire dtype {wire!r} (expected one of {WIRE_DTYPES})")
+    return wire
+
+
+def _narrowable(dtype: np.dtype) -> bool:
+    """Only full-width floats narrow; ints/bools/f16 ship verbatim."""
+    return dtype.kind == "f" and dtype.itemsize >= 4
+
+
+def wire_bytes_saved(record: TensorValue, wire: typing.Optional[str]) -> int:
+    """Field-buffer bytes a narrowed frame saves vs. the identity codec
+    (header/meta overhead excluded — it is identical modulo the few
+    bytes of wire tags)."""
+    wire = normalize_wire_dtype(wire)
+    if wire is None:
+        return 0
+    itemsize = _wire_np_dtype(wire).itemsize
+    saved = 0
+    for arr in record.fields.values():
+        a = np.asarray(arr)
+        if _narrowable(a.dtype):
+            saved += a.size * (a.dtype.itemsize - itemsize)
+    return saved
+
+
+def _narrow(a: np.ndarray, wire: str):
+    """``(buffer_bytes, scale)`` of one field narrowed to ``wire``."""
+    if wire == "int8":
+        absmax = float(np.max(np.abs(a))) if a.size else 0.0
+        scale = absmax / 127.0 if absmax > 0.0 else 1.0
+        q = np.clip(np.rint(a.astype(np.float64) / scale), -127, 127)
+        return q.astype(np.int8).tobytes(), scale
+    return a.astype(_wire_np_dtype(wire)).tobytes(), None
+
+
+def encode_record(record: TensorValue,
+                  wire_dtype: typing.Optional[str] = None) -> bytes:
+    wire = normalize_wire_dtype(wire_dtype)
     fields = []
     buffers = []
     for name, arr in record.fields.items():
@@ -48,8 +123,13 @@ def encode_record(record: TensorValue) -> bytes:
             )
         # NB: ascontiguousarray would promote 0-d to 1-d; keep the true
         # shape and let tobytes() handle contiguity.
-        fields.append([name, list(a.shape), a.dtype.str])
-        buffers.append(a.tobytes())
+        if wire is not None and _narrowable(a.dtype):
+            buf, scale = _narrow(a, wire)
+            fields.append([name, list(a.shape), a.dtype.str, wire, scale])
+            buffers.append(buf)
+        else:
+            fields.append([name, list(a.shape), a.dtype.str])
+            buffers.append(a.tobytes())
     header = json.dumps({"fields": fields}).encode()
     meta = pickle.dumps(dict(record.meta), protocol=pickle.HIGHEST_PROTOCOL)
     return b"".join(
@@ -68,10 +148,29 @@ def decode_record(data: typing.Union[bytes, memoryview]) -> TensorValue:
     meta = pickle.loads(view[off:off + meta_len])
     off += meta_len
     out = {}
-    for name, shape, dtype_str in header["fields"]:
+    for entry in header["fields"]:
+        name, shape, dtype_str = entry[0], entry[1], entry[2]
         dtype = np.dtype(dtype_str)
         count = int(np.prod(shape)) if shape else 1  # prod(()) is 1 anyway
-        arr = np.frombuffer(view, dtype=dtype, count=count, offset=off).reshape(shape)
+        if len(entry) > 3:
+            # Narrowed field: the buffer is laid out in the wire dtype;
+            # restore the declared dtype here so the narrowing never
+            # leaks past the codec (the restore allocates — zero-copy is
+            # a property of the identity path only).
+            wire, scale = entry[3], entry[4]
+            wdt = _wire_np_dtype(wire)
+            raw = np.frombuffer(view, dtype=wdt, count=count, offset=off)
+            if wire == "int8":
+                arr = (raw.astype(dtype) * dtype.type(scale)).reshape(shape)
+            else:
+                arr = raw.astype(dtype).reshape(shape)
+            # Freshly allocated by astype — freeze in place so the
+            # TensorValue constructor aliases instead of re-copying.
+            arr.setflags(write=False)
+            off += count * wdt.itemsize
+        else:
+            arr = np.frombuffer(view, dtype=dtype, count=count,
+                                offset=off).reshape(shape)
+            off += count * dtype.itemsize
         out[name] = arr
-        off += count * dtype.itemsize
     return TensorValue(out, meta)

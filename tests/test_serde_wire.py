@@ -1,0 +1,164 @@
+"""Compact wire-dtype codec (ISSUE 7): bf16/f16/int8 on-the-wire
+narrowing with dtype restored at decode — round-trip tolerance bounds,
+unchanged object-dtype rejection, and cross-process frame decode of
+narrowed dtypes over the io/remote record plane."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+from flink_tensorflow_tpu.tensors import TensorValue
+from flink_tensorflow_tpu.tensors.serde import (
+    WIRE_DTYPES,
+    decode_record,
+    encode_record,
+    normalize_wire_dtype,
+    wire_bytes_saved,
+)
+
+
+def _rec(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return TensorValue(
+        {"x": (rng.rand(n).astype(np.float32) - 0.5) * 6.0,
+         "label": np.int32(7),
+         "flags": rng.rand(4) > 0.5},
+        {"id": seed},
+    )
+
+
+class TestWireNarrowing:
+    def test_identity_frames_unchanged(self):
+        rec = _rec()
+        assert encode_record(rec, None) == encode_record(rec, "f32")
+        out = decode_record(encode_record(rec, "f32"))
+        assert out == rec
+
+    def test_bf16_roundtrip_tolerance_and_dtype_restored(self):
+        rec = _rec()
+        out = decode_record(encode_record(rec, "bf16"))
+        assert out["x"].dtype == np.float32
+        # bf16 keeps ~8 mantissa bits: relative error <= 2^-8 per value.
+        np.testing.assert_allclose(out["x"], rec["x"], rtol=2 ** -7, atol=1e-6)
+        # non-float fields bit-exact
+        assert out["label"] == rec["label"]
+        np.testing.assert_array_equal(out["flags"], rec["flags"])
+
+    def test_f16_roundtrip_tolerance(self):
+        rec = _rec()
+        out = decode_record(encode_record(rec, "f16"))
+        assert out["x"].dtype == np.float32
+        np.testing.assert_allclose(out["x"], rec["x"], rtol=2 ** -10, atol=1e-6)
+
+    def test_int8_roundtrip_absmax_bound(self):
+        rec = _rec()
+        out = decode_record(encode_record(rec, "int8"))
+        assert out["x"].dtype == np.float32
+        absmax = float(np.max(np.abs(rec["x"])))
+        # uniform absmax quantization: worst-case error absmax/127 * 0.5,
+        # plus rounding slack
+        assert float(np.max(np.abs(out["x"] - rec["x"]))) <= absmax / 127.0
+
+    def test_int8_all_zero_field(self):
+        rec = TensorValue({"x": np.zeros(8, np.float32)})
+        out = decode_record(encode_record(rec, "int8"))
+        np.testing.assert_array_equal(out["x"], rec["x"])
+
+    def test_frame_actually_shrinks(self):
+        rec = _rec(1024)
+        full = len(encode_record(rec, None))
+        half = len(encode_record(rec, "bf16"))
+        quarter = len(encode_record(rec, "int8"))
+        assert half < full and quarter < half
+        assert wire_bytes_saved(rec, "bf16") == 1024 * 2
+        assert wire_bytes_saved(rec, "int8") == 1024 * 3
+        assert wire_bytes_saved(rec, None) == 0
+
+    def test_object_dtype_rejection_unchanged(self):
+        # Build via __setstate__ to smuggle an object array past the ctor
+        bad = TensorValue.__new__(TensorValue)
+        bad.__setstate__(
+            {"fields": {"o": np.array([object()], dtype=object)}, "meta": {}})
+        for wire in (None, "bf16", "int8"):
+            with pytest.raises(TypeError, match="object dtype"):
+                encode_record(bad, wire)
+
+    def test_unknown_wire_dtype_rejected(self):
+        with pytest.raises(ValueError, match="wire dtype"):
+            encode_record(_rec(), "fp8")
+        with pytest.raises(ValueError):
+            normalize_wire_dtype("nope")
+        assert normalize_wire_dtype("f32") is None
+        assert set(WIRE_DTYPES) == {"f32", "bf16", "f16", "int8"}
+
+    def test_half_width_fields_pass_through(self):
+        rec = TensorValue({"h": np.zeros(4, np.float16)})
+        # already narrow: bf16 narrowing must not touch f16 buffers
+        assert encode_record(rec, "bf16") == encode_record(rec, None)
+
+
+class TestRemoteNarrowedFrames:
+    def test_cross_process_decode_of_narrowed_frames(self):
+        """RemoteSink ships bf16 frames; the receiving RemoteSource needs
+        no flag — decode restores f32 within bf16 tolerance."""
+        source = RemoteSource(bind="127.0.0.1")
+        sent = [
+            TensorValue({"x": np.linspace(-3, 3, 32).astype(np.float32) * i},
+                        {"i": i})
+            for i in range(20)
+        ]
+
+        def upstream():
+            env = StreamExecutionEnvironment(parallelism=1)
+            (
+                env.from_collection(sent)
+                .add_sink(RemoteSink("127.0.0.1", source.port,
+                                     wire_dtype="bf16"))
+            )
+            env.execute(timeout=60)
+
+        t = threading.Thread(target=upstream)
+        t.start()
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        out = env2.from_source(source).sink_to_list()
+        env2.execute(timeout=60)
+        t.join()
+
+        assert len(out) == 20
+        got = {r.meta["i"]: r for r in out}
+        for i, rec in enumerate(sent):
+            assert got[i]["x"].dtype == np.float32
+            np.testing.assert_allclose(got[i]["x"], rec["x"],
+                                       rtol=2 ** -7, atol=1e-5)
+
+    def test_sink_defaults_to_job_wire_dtype(self):
+        """RemoteSink without an explicit wire_dtype inherits
+        JobConfig.wire_dtype and counts wire_bytes_saved."""
+        source = RemoteSource(bind="127.0.0.1")
+        sent = [TensorValue({"x": np.ones(64, np.float32)}, {"i": i})
+                for i in range(4)]
+        saved = {}
+
+        def upstream():
+            env = StreamExecutionEnvironment(parallelism=1)
+            env.configure(wire_dtype="f16")
+            (
+                env.from_collection(sent)
+                .add_sink(RemoteSink("127.0.0.1", source.port), name="rsink")
+            )
+            env.execute(timeout=60)
+            saved.update({
+                k: v for k, v in env.metric_registry.report().items()
+                if k.endswith("wire_bytes_saved")})
+
+        t = threading.Thread(target=upstream)
+        t.start()
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        out = env2.from_source(source).sink_to_list()
+        env2.execute(timeout=60)
+        t.join()
+        assert len(out) == 4
+        assert sum(saved.values()) == 4 * 64 * 2  # f32 -> f16 halves
