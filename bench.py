@@ -2196,6 +2196,228 @@ def bench_deviceres(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# workload 8: cross-process shuffle microbenchmark (the record plane)
+# ---------------------------------------------------------------------------
+
+#: Sender half of the shuffle microbench, run as a REAL separate process
+#: (python -c) so the frames cross a genuine process boundary — loopback
+#: TCP or the same-host shm ring, exactly like a cohort worker.
+_SHUFFLE_SENDER = r"""
+import sys
+import numpy as np
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core.shuffle import RemoteChannelWriter
+from flink_tensorflow_tpu.tensors import TensorValue
+
+port, n, floats, flush_bytes, flush_ms, columnar, shm = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+    float(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]))
+rng = np.random.RandomState(0)
+# A 64-record content pool: distinct bytes record to record (no
+# dedup-friendly wire), built OUTSIDE the measured stream.
+pool = [TensorValue({"x": rng.rand(floats).astype(np.float32)}, {})
+        for _ in range(64)]
+w = RemoteChannelWriter("127.0.0.1", port, "bench", 0, 0,
+                        connect_timeout_s=30.0, flush_bytes=flush_bytes,
+                        flush_ms=flush_ms, columnar=bool(columnar),
+                        shm=bool(shm))
+for i in range(n):
+    w.write(el.StreamRecord(pool[i & 63]))
+w.write(el.EndOfPartition())
+w.close()
+"""
+
+
+def _shuffle_arm(n, floats, *, flush_bytes, flush_ms, columnar, shm,
+                 capacity=8192) -> dict:
+    """One (arm, record-size) pass: subprocess sender -> this process's
+    reactor-backed ShuffleServer; sustained payload MB/s measured from
+    first record arrival to EndOfPartition."""
+    import subprocess
+    import sys
+
+    from flink_tensorflow_tpu.core import elements as el
+    from flink_tensorflow_tpu.core.channels import InputGate
+    from flink_tensorflow_tpu.core.shuffle import ShuffleServer
+
+    gate = InputGate(1, capacity=capacity)
+    server = ShuffleServer("127.0.0.1")
+    server.register_gate("bench", 0, gate)
+    server.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__)),
+         env.get("PYTHONPATH", "")])
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SHUFFLE_SENDER, str(server.port), str(n),
+         str(floats), str(flush_bytes), str(flush_ms), str(int(columnar)),
+         str(int(shm))],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    got = 0
+    t0 = t1 = None
+    try:
+        while True:
+            item = gate.poll(timeout=120.0)
+            assert item is not None, "shuffle bench stalled"
+            element = item[1]
+            if isinstance(element, el.StreamRecord):
+                if t0 is None:
+                    t0 = time.monotonic()
+                got += 1
+            elif isinstance(element, el.EndOfPartition):
+                t1 = time.monotonic()
+                break
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0, out.decode(errors="replace")
+    finally:
+        proc.kill()
+        server.close()
+    assert got == n, f"lost records: {got}/{n}"
+    span = (t1 - t0) if (t0 is not None and t1 > t0) else float("nan")
+    payload = n * floats * 4
+    return {
+        "records": n,
+        "record_bytes": floats * 4,
+        "span_s": round(span, 4),
+        "records_per_sec": round(n / span, 1) if span == span else None,
+        "wire_sustained_mb_s": (round(payload / span / 1e6, 2)
+                                if span == span else None),
+    }
+
+
+def _shuffle_trace_attribution(n, floats, **writer_knobs) -> dict:
+    """In-process traced pass over the wire: the flink-tpu-trace stage
+    table over wire.flush / serde / wire spans — how much of the plane's
+    time is coalescing delay vs encode vs send.  ``writer_knobs``
+    selects the arm (e.g. ``flush_bytes=0`` is the per-record BEFORE)."""
+    import threading
+
+    from flink_tensorflow_tpu import tracing
+    from flink_tensorflow_tpu.core import elements as el
+    from flink_tensorflow_tpu.core.channels import InputGate
+    from flink_tensorflow_tpu.core.shuffle import (
+        RemoteChannelWriter,
+        ShuffleServer,
+    )
+    from flink_tensorflow_tpu.tensors import TensorValue
+    from flink_tensorflow_tpu.tracing.attribution import (
+        attribution,
+        format_attribution_table,
+    )
+
+    tracer = tracing.Tracer(sample_rate=1.0, seed=0)
+    gate = InputGate(1, capacity=8192)
+    server = ShuffleServer("127.0.0.1")
+    server.register_gate("bench", 0, gate)
+    server.start()
+    rng = np.random.RandomState(0)
+    pool = [TensorValue({"x": rng.rand(floats).astype(np.float32)}, {})
+            for _ in range(64)]
+    w = RemoteChannelWriter("127.0.0.1", server.port, "bench", 0, 0,
+                            connect_timeout_s=30.0, tracer=tracer,
+                            **writer_knobs)
+
+    def produce():
+        for i in range(n):
+            w.write(el.StreamRecord(pool[i & 63]))
+        w.write(el.EndOfPartition())
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = gate.poll(timeout=60.0)
+            if item is not None and isinstance(item[1], el.EndOfPartition):
+                break
+    finally:
+        t.join(timeout=10)
+        w.close()
+        server.close()
+    attr = attribution(tracer.events())
+    table = format_attribution_table(attr)
+    return {"table": table.splitlines(), "rows": attr}
+
+
+def bench_shuffle(args) -> dict:
+    """Cross-process record-plane microbenchmark (ISSUE 8 acceptance):
+    sweeps record sizes over coalescing x columnar x shm arms and
+    reports ``wire_sustained_mb_s`` + records/sec per arm.  The small-
+    record speedup (coalescing+columnar vs the per-record baseline) and
+    the shm-vs-TCP ratio are the headline rows."""
+    # NB: args.records is not applied here — smoke mode pins it to 16
+    # for the model workloads, far below anything measurable on a wire.
+    if args.smoke:
+        sizes = [(64, 2000), (1024, 1000)]
+    else:
+        sizes = [(64, 40000), (1024, 20000), (16384, 2000)]
+
+    arms = {
+        # flush_bytes=0 IS the pre-PR-8 wire: one frame per record.
+        "percord_tcp": dict(flush_bytes=0, flush_ms=0.0,
+                            columnar=False, shm=False),
+        "coalesce_tcp": dict(flush_bytes=64 << 10, flush_ms=5.0,
+                             columnar=False, shm=False),
+        "coalesce_columnar_tcp": dict(flush_bytes=64 << 10, flush_ms=5.0,
+                                      columnar=True, shm=False),
+        "coalesce_columnar_shm": dict(flush_bytes=64 << 10, flush_ms=5.0,
+                                      columnar=True, shm=True),
+    }
+    results: dict = {name: [] for name in arms}
+    repeats = 1 if args.smoke else 2
+    for floats, n in sizes:
+        for name, knobs in arms.items():
+            # Best-of-N: one scheduler hiccup on a 1-2s arm skews the
+            # sustained rate by 10-20%; the max is the honest capability
+            # number for a throughput microbench.
+            runs = [_shuffle_arm(n, floats, **knobs) for _ in range(repeats)]
+            results[name].append(
+                max(runs, key=lambda r: r["wire_sustained_mb_s"] or 0.0))
+
+    def _mbs(arm, idx):
+        runs = results[arm]
+        return runs[idx]["wire_sustained_mb_s"] if idx < len(runs) else None
+
+    # Acceptance ratios on the SMALL (<=4KB) record sizes.
+    small_idx = [i for i, (f, _) in enumerate(sizes) if f * 4 <= 4096]
+    speedups = [
+        _mbs("coalesce_columnar_tcp", i) / _mbs("percord_tcp", i)
+        for i in small_idx
+        if _mbs("percord_tcp", i) and _mbs("coalesce_columnar_tcp", i)
+    ]
+    shm_ratios = [
+        _mbs("coalesce_columnar_shm", i) / _mbs("coalesce_columnar_tcp", i)
+        for i in range(len(sizes))
+        if _mbs("coalesce_columnar_tcp", i) and _mbs("coalesce_columnar_shm", i)
+    ]
+    trace_n = 2000 if args.smoke else 10000
+    trace = {
+        # BEFORE: the per-record wire (flush_bytes=0); AFTER: coalesced
+        # defaults — the pair the acceptance's attribution table wants.
+        "percord": _shuffle_trace_attribution(trace_n, 1024, flush_bytes=0),
+        "coalesced": _shuffle_trace_attribution(trace_n, 1024),
+    }
+    best_small = max(
+        (_mbs("coalesce_columnar_shm", i) or 0) for i in small_idx)
+    return {
+        "metric": "wire_sustained_mb_s",
+        "value": best_small,
+        "unit": "MB/s",
+        "vs_baseline": None,
+        "record_sizes_bytes": [f * 4 for f, _ in sizes],
+        "arms": results,
+        "coalesce_columnar_speedup_small_records":
+            [round(s, 2) for s in speedups],
+        "shm_vs_loopback_tcp_ratio": [round(r, 2) for r in shm_ratios],
+        "trace_attribution": trace,
+        "baseline_note": (
+            "percord_tcp IS the pre-coalescing wire (one pickle frame "
+            "per record over thread-per-connection TCP semantics); all "
+            "arms cross a real process boundary"),
+    }
+
+
 WORKLOADS = {
     "inception": bench_inception,
     "mnist": bench_mnist,
@@ -2204,6 +2426,7 @@ WORKLOADS = {
     "resnet": bench_resnet,
     "filesplit": bench_filesplit,
     "deviceres": bench_deviceres,
+    "shuffle": bench_shuffle,
 }
 
 #: --workload aliases, resolved before dispatch ("all" never expands

@@ -621,6 +621,54 @@ def _device_residency(ctx: AnalysisContext, emit: Emit) -> None:
                 )
 
 
+@rule("remote-edge-buffer-timeout", Severity.WARN)
+def _remote_edge_buffer_timeout(ctx: AnalysisContext, emit: Emit) -> None:
+    """Latency-sensitive plan behind a large remote buffer timeout: an
+    open-loop paced source measures arrival-schedule latency, but every
+    remote edge (cohort shuffle channel or RemoteSink) holds partially
+    filled frames for up to ``wire_flush_ms`` before sending — the
+    coalescing delay lands straight on the measured tail.  Flink's
+    guidance for its equivalent knob (bufferTimeout) is the same: large
+    values buy throughput for pipelines, small values serve
+    latency-bound jobs.  Set ``JobConfig.wire_flush_ms`` low (or 0 =
+    flush per record) for open-loop latency runs."""
+    cfg = ctx.config
+    if cfg is None:
+        return
+    flush_ms = getattr(cfg, "wire_flush_ms", None)
+    if flush_ms is None or flush_ms <= 10.0:
+        return
+    # Remote edges exist when the job spans a cohort, or when a sink
+    # ships records over the io/remote plane.
+    def _is_remote_sink(t: Transformation) -> bool:
+        function = ctx.function_of(t)
+        return type(function).__name__ == "RemoteSink"
+
+    has_remote = getattr(cfg, "distributed", None) is not None or any(
+        _is_remote_sink(t) for t in ctx.order
+    )
+    if not has_remote:
+        return
+    try:
+        from flink_tensorflow_tpu.sources.paced import PacedSplitSource
+    except Exception:  # pragma: no cover - import cycle guard
+        PacedSplitSource = ()  # type: ignore[assignment]
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        source = getattr(op, "source", None)
+        paced = isinstance(source, PacedSplitSource) or getattr(
+            source, "is_open_loop", False)
+        if paced:
+            emit(
+                f"open-loop paced source feeds a plan with remote edges "
+                f"while wire_flush_ms={flush_ms:g} — up to {flush_ms:g}ms "
+                "of coalescing delay is added to every measured arrival; "
+                "lower JobConfig.wire_flush_ms (0 flushes per record) "
+                "for latency-bound runs",
+                node=t.name,
+            )
+
+
 @rule("recompile-churn", Severity.WARN)
 def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
     """Shape-signature churn at jit boundaries: several distinct schemas

@@ -151,6 +151,27 @@ class JobConfig:
     #: None/"f32" ships full width.  FLINK_TPU_WIRE_DTYPE overrides.
     #: Accuracy caveats documented in tensors/serde.py.
     wire_dtype: typing.Optional[str] = None
+    #: Frame coalescing on the remote record plane (core/shuffle.py,
+    #: io/remote.py — Flink's network-buffer model): records buffer
+    #: until this many estimated payload bytes, then flush as ONE
+    #: multi-record frame.  0 disables coalescing (frame per record,
+    #: the pre-coalescing wire).  FLINK_TPU_WIRE_FLUSH_BYTES overrides.
+    wire_flush_bytes: int = 64 * 1024
+    #: Flink-style buffer timeout: a partially filled buffer flushes
+    #: this many milliseconds after its FIRST record, bounding the
+    #: latency coalescing may add.  Barriers, watermarks and
+    #: end-of-partition always force an immediate flush (alignment and
+    #: exactly-once semantics never wait on the timeout).  0 flushes
+    #: every record (Flink's bufferTimeout=0).  FLINK_TPU_WIRE_FLUSH_MS
+    #: overrides.  Latency-sensitive open-loop jobs should keep this
+    #: small — see the `remote-edge-buffer-timeout` lint.
+    wire_flush_ms: float = 5.0
+    #: Same-host shuffle edges ride a shared-memory ring
+    #: (native/ring.ShmByteRing over tmpfs) instead of loopback TCP —
+    #: the kernel network stack is skipped entirely; the TCP connection
+    #: remains as handshake/wakeup/liveness channel.  Cross-host edges
+    #: are unaffected.  FLINK_TPU_SHM=0/1 overrides.
+    shm_channels: bool = True
     #: Sleep between source emissions — test/backpressure pacing.
     source_throttle_s: float = 0.0
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
@@ -186,6 +207,14 @@ class JobConfig:
         if self.source_throttle_s < 0:
             raise ValueError(
                 f"source_throttle_s must be >= 0, got {self.source_throttle_s}"
+            )
+        if self.wire_flush_bytes < 0:
+            raise ValueError(
+                f"wire_flush_bytes must be >= 0, got {self.wire_flush_bytes}"
+            )
+        if self.wire_flush_ms < 0:
+            raise ValueError(
+                f"wire_flush_ms must be >= 0, got {self.wire_flush_ms}"
             )
         if self.wire_dtype is not None:
             from flink_tensorflow_tpu.tensors.serde import WIRE_DTYPES

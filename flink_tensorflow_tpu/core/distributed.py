@@ -215,6 +215,16 @@ class DistributedExecutor(LocalExecutor):
             host, port, t.name, subtask_index, channel_idx,
             connect_timeout_s=self.dist.connect_timeout_s,
             metrics=self.metrics,
+            # High-throughput plane: coalesced frames (columnar when
+            # homogeneous, narrowed to the job wire dtype), async sends
+            # on the server's process-wide reactor, and the shm ring for
+            # a same-host peer.
+            flush_bytes=self.wire_flush_bytes,
+            flush_ms=self.wire_flush_ms,
+            wire_dtype=self.wire_dtype,
+            reactor=self._server.reactor,
+            shm=self.shm_channels,
+            tracer=self.tracer,
         )
         self._remote_writers.append(writer)
         return writer

@@ -336,6 +336,9 @@ class StreamExecutionEnvironment:
             sanitize=cfg.sanitize,
             device_resident=cfg.device_resident,
             wire_dtype=cfg.wire_dtype,
+            wire_flush_bytes=cfg.wire_flush_bytes,
+            wire_flush_ms=cfg.wire_flush_ms,
+            shm_channels=cfg.shm_channels,
             trace=cfg.trace,
             trace_path=cfg.trace_path,
             trace_sample_rate=cfg.trace_sample_rate,

@@ -640,6 +640,9 @@ class LocalExecutor:
         trace_sample_rate: float = 1.0,
         device_resident: bool = False,
         wire_dtype: typing.Optional[str] = None,
+        wire_flush_bytes: typing.Optional[int] = None,
+        wire_flush_ms: typing.Optional[float] = None,
+        shm_channels: bool = True,
     ):
         from flink_tensorflow_tpu import tracing
         from flink_tensorflow_tpu.core import sanitizer_rt
@@ -662,6 +665,17 @@ class LocalExecutor:
         self.wire_dtype = wire_dtype if wire_dtype is not None else env_wire_dtype()
         if self.wire_dtype == "f32":
             self.wire_dtype = None
+        #: Remote-plane coalescing knobs (JobConfig.wire_flush_bytes /
+        #: wire_flush_ms; FLINK_TPU_WIRE_FLUSH_* take precedence inside
+        #: the writers) and the same-host shm upgrade.  A LocalExecutor
+        #: has no remote edges — these only feed RemoteSink defaults via
+        #: the RuntimeContext and the DistributedExecutor's writers.
+        self.wire_flush_bytes = wire_flush_bytes
+        self.wire_flush_ms = wire_flush_ms
+        from flink_tensorflow_tpu.core.shuffle import env_shm_enabled
+
+        env_shm = env_shm_enabled()
+        self.shm_channels = shm_channels if env_shm is None else env_shm
         #: Debug-mode concurrency sanitizer (core/sanitizer_rt):
         #: JobConfig.sanitize=True or FLINK_TPU_SANITIZE=1 instruments
         #: every gate/mailbox/coordinator lock and asserts the barrier
@@ -973,6 +987,10 @@ class LocalExecutor:
             # emission mode / h2d wire dtype from these at open().
             ctx.device_resident = self.device_resident
             ctx.wire_dtype = self.wire_dtype
+            # Remote-plane coalescing defaults (RemoteSink reads these
+            # at open() when its own knobs are unset).
+            ctx.wire_flush_bytes = self.wire_flush_bytes
+            ctx.wire_flush_ms = self.wire_flush_ms
             if head_gate is not None:
                 # Operator-owned background threads (the model runner's
                 # fetch thread) use this to break the CHAIN's event wait

@@ -5,37 +5,56 @@ The reference's record plane is Flink's credit-based Netty shuffle: a
 flow THROUGH the network channels so alignment (and therefore
 exactly-once) works cluster-wide (SURVEY.md §1 L1, §2 "Distributed
 communication backend").  This module is that plane for the TPU
-framework's host-side record traffic:
+framework's host-side record traffic, rebuilt for throughput around
+Flink's production answers:
 
-- :class:`ShuffleServer` — one per process: accepts peer connections and
-  feeds the local subtasks' :class:`~...channels.InputGate`\\ s.  A
-  connection handshakes with its destination ``(task, subtask,
-  channel)`` route, then streams frames.
-- :class:`RemoteChannelWriter` — the :class:`ChannelWriter` contract
-  over one TCP connection.  Per-channel FIFO comes from TCP ordering +
-  the single upstream writer thread, exactly like the in-process queue.
+- **Frame coalescing** — :class:`RemoteChannelWriter` buffers records
+  and flushes ONE multi-record frame on a size threshold
+  (``wire_flush_bytes``) or a Flink-style buffer timeout
+  (``wire_flush_ms``).  Barriers, watermarks and end-of-partition force
+  an immediate flush, so alignment latency and exactly-once semantics
+  are untouched by batching.
+- **Columnar fast path** — a coalesced frame whose records are
+  homogeneous ``TensorValue``\\ s encodes arrow-style
+  (tensors/serde.encode_batch: one header + per-field contiguous
+  buffers) instead of N independent pickles, composing with the
+  bf16/f16/int8 wire-dtype narrowing; heterogeneous frames fall back to
+  one pickled element list.
+- **Async event loop** — :class:`ShuffleServer` runs on a
+  ``selectors``-based :class:`~flink_tensorflow_tpu.core.reactor.Reactor`
+  (ONE thread per process, not one per socket): non-blocking sockets,
+  per-connection receive state machines, writer-side send queues.  The
+  backpressure contract is unchanged: a full ``InputGate`` PAUSES that
+  connection's reads, the kernel TCP window closes, and the remote
+  sender blocks — resumed event-driven by the gate's space listener.
+- **Shared-memory same-host edges** — a writer whose peer shares the
+  host routes frames over a :class:`~flink_tensorflow_tpu.native.ring.
+  ShmByteRing` (tmpfs mmap, the TensorRing arena's cross-process
+  sibling) instead of loopback TCP; the TCP connection remains as the
+  handshake/wakeup/liveness channel, so peer death and EOP semantics
+  are identical to the TCP path.
 
-EVERY stream element crosses the wire — records, watermarks, checkpoint
-barriers, end-of-partition — so downstream barrier alignment is real
-alignment, not a convention.  Backpressure is the transport's: the
-receiving gate's bounded queue stalls the reader thread, the kernel TCP
-window fills, and the remote ``sendall`` blocks.
-
-Gradients never touch this plane: they ride XLA collectives over
-ICI/DCN inside compiled steps (SURVEY.md §2).  This plane is the
-reference's *record* shuffle only.
+EVERY stream element crosses the plane — records, watermarks,
+checkpoint barriers, end-of-partition — so downstream barrier alignment
+is real alignment, not a convention.  Gradients never touch this plane:
+they ride XLA collectives over ICI/DCN inside compiled steps
+(SURVEY.md §2).
 
 Framing: ``[u32 pickle_len][u16 nbuf][pickle][per buffer: u64 len +
-raw bytes]`` — pickle protocol 5 with OUT-OF-BAND buffers, so a
-record's numpy payload travels as raw buffer views (scatter-gather
-sendall), never copied into the pickle stream.  The wire is trusted
-(cluster-internal, same codebase both ends), matching the reference's
-Java-serialization posture inside a Flink cluster.
+raw bytes]`` — pickle protocol 5 with OUT-OF-BAND buffers, so tensor
+payloads travel as raw buffer views (scatter-gather), never copied into
+the pickle stream.  A coalesced frame pickles either a list of elements
+or a :class:`ColumnarFrame` wrapper whose columnar payload rides as one
+out-of-band buffer.  The wire is trusted (cluster-internal, same
+codebase both ends), matching the reference's Java-serialization
+posture inside a Flink cluster.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
+import os
 import pickle
 import socket
 import struct
@@ -43,7 +62,23 @@ import threading
 import time
 import typing
 
+import numpy as np
+
 from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core.reactor import (
+    Connection,
+    FlushScheduler,
+    Reactor,
+    ShuffleFrameParser,
+)
+from flink_tensorflow_tpu.native.ring import ShmByteRing, shm_dir
+from flink_tensorflow_tpu.tensors.serde import (
+    batch_signature,
+    decode_batch,
+    encode_batch,
+    normalize_wire_dtype,
+)
+from flink_tensorflow_tpu.tensors.value import TensorValue
 
 if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.core.channels import InputGate
@@ -54,6 +89,137 @@ _FRAME_HDR = struct.Struct("<IH")  # pickle byte length, out-of-band buffer coun
 _BUF_HDR = struct.Struct("<Q")
 _MAX_FRAME = 1 << 30
 _SMALL_FRAME = 1 << 16
+
+#: Defaults for the coalescing knobs (JobConfig.wire_flush_bytes /
+#: wire_flush_ms override per job; FLINK_TPU_WIRE_FLUSH_* per process).
+DEFAULT_FLUSH_BYTES = 64 << 10
+DEFAULT_FLUSH_MS = 5.0
+
+#: Data frame telling an shm-mode receiver "the ring has frames" — a
+#: full pickled frame (not a raw byte) so the notify channel speaks the
+#: one framing every connection already parses.
+RING_NOTIFY = "__ring_notify__"
+
+_RING_NOTIFY_WIRE: typing.Optional[bytes] = None
+
+
+def _ring_notify_wire() -> bytes:
+    """The notify frame's wire bytes, encoded once — the doorbell is hot
+    enough that a per-flush pickle shows up in profiles."""
+    global _RING_NOTIFY_WIRE
+    if _RING_NOTIFY_WIRE is None:
+        parts, _ = encode_obj_frame(RING_NOTIFY)
+        _RING_NOTIFY_WIRE = b"".join(bytes(p) for p in parts)
+    return _RING_NOTIFY_WIRE
+
+
+def env_flush_bytes() -> typing.Optional[int]:
+    v = os.environ.get("FLINK_TPU_WIRE_FLUSH_BYTES")
+    return int(v) if v else None
+
+
+def env_flush_ms() -> typing.Optional[float]:
+    v = os.environ.get("FLINK_TPU_WIRE_FLUSH_MS")
+    return float(v) if v else None
+
+
+def env_shm_enabled() -> typing.Optional[bool]:
+    v = os.environ.get("FLINK_TPU_SHM")
+    if v is None or v == "":
+        return None
+    return v.lower() in ("1", "true", "on", "yes")
+
+
+class ColumnarFrame:
+    """A coalesced homogeneous record run on the wire: the arrow-style
+    payload (tensors/serde.encode_batch bytes) rides as ONE out-of-band
+    pickle buffer (the uint8 wrap makes pickle-5 treat it as such);
+    timestamps/traces are per-record sidecars (None when uniform-None).
+    """
+
+    __slots__ = ("payload", "timestamps", "traces")
+
+    def __init__(self, payload, timestamps, traces):
+        self.payload = payload
+        self.timestamps = timestamps
+        self.traces = traces
+
+    def __getstate__(self):
+        return (self.payload, self.timestamps, self.traces)
+
+    def __setstate__(self, state):
+        self.payload, self.timestamps, self.traces = state
+
+    def records(self) -> typing.List[el.StreamRecord]:
+        values = decode_batch(memoryview(self.payload))
+        ts, traces = self.timestamps, self.traces
+        return [
+            el.StreamRecord(
+                v,
+                None if ts is None else ts[i],
+                None if traces is None else traces[i],
+            )
+            for i, v in enumerate(values)
+        ]
+
+
+def expand_message(obj) -> typing.List[typing.Any]:
+    """One decoded wire frame -> the element run it carries (a single
+    element, a heterogeneous pickled list, or a columnar batch)."""
+    if type(obj) is list:
+        return obj
+    if type(obj) is ColumnarFrame:
+        return obj.records()
+    return [obj]
+
+
+def encode_obj_frame(obj: typing.Any) -> typing.Tuple[typing.List[typing.Any], int]:
+    """Serialize one frame; returns ``(wire_parts, payload_bytes)``.
+
+    Pickle protocol 5 with out-of-band buffers: tensor payloads become
+    raw buffer views (scatter-gather send), NOT copies into the pickle
+    stream.  Non-contiguous leaves (rare) fall back to in-band pickling.
+    ``payload_bytes`` counts pickle + buffer bytes (header structs
+    excluded), matching the receiver's accounting.
+    """
+    bufs: typing.List[pickle.PickleBuffer] = []
+    try:
+        data = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+        raws = [b.raw() for b in bufs]
+    except BufferError:
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        raws = []
+    parts: typing.List[typing.Any] = [_FRAME_HDR.pack(len(data), len(raws)), data]
+    total = len(data)
+    for raw in raws:
+        parts.append(_BUF_HDR.pack(raw.nbytes))
+        parts.append(raw)
+        total += raw.nbytes
+    return parts, total
+
+
+def _sendall_parts(sock: socket.socket, parts: typing.Sequence[typing.Any]) -> None:
+    """Send a multi-part frame with scatter-gather ``sendmsg`` — ONE
+    syscall per frame instead of one per part (or a concatenation copy),
+    looping on partial sends."""
+    views = [memoryview(p) if not isinstance(p, memoryview) else p
+             for p in parts]
+    views = [v.cast("B") if v.format != "B" or v.ndim != 1 else v
+             for v in views]
+    if not hasattr(sock, "sendmsg"):  # pragma: no cover - non-POSIX
+        for v in views:
+            sock.sendall(v)
+        return
+    while views:
+        sent = sock.sendmsg(views)
+        while sent:
+            head = views[0]
+            if sent >= head.nbytes:
+                sent -= head.nbytes
+                views.pop(0)
+            else:
+                views[0] = head[sent:]
+                sent = 0
 
 
 def _recv_exact(conn: socket.socket, n: int) -> typing.Optional[bytes]:
@@ -88,32 +254,14 @@ def _recv_buffer(conn: socket.socket, n: int) -> bytearray:
 
 
 def _send_obj(conn: socket.socket, obj: typing.Any) -> int:
-    """Serialize + send one frame; returns payload bytes on the wire.
-
-    Pickle protocol 5 with out-of-band buffers: a record's numpy payload
-    is sent as raw buffer views (scatter-gather), NOT copied into the
-    pickle stream — the send side of the "zero-copy record plane".
-    Non-contiguous leaves (rare) fall back to in-band pickling.
-    Layout: [u32 pickle_len][u16 nbuf][pickle][per buf: u64 len][bytes].
-    """
-    bufs: typing.List[pickle.PickleBuffer] = []
-    try:
-        data = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
-        raws = [b.raw() for b in bufs]
-    except BufferError:
-        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        raws = []
-    parts: typing.List[typing.Any] = [_FRAME_HDR.pack(len(data), len(raws)), data]
-    total = len(data)
-    for raw in raws:
-        parts.append(_BUF_HDR.pack(raw.nbytes))
-        parts.append(raw)
-        total += raw.nbytes
+    """Blocking single-frame send (handshakes, standalone writers);
+    returns payload bytes on the wire."""
+    parts, total = encode_obj_frame(obj)
     if total < _SMALL_FRAME:
         conn.sendall(b"".join(parts))  # join accepts memoryview parts
     else:
         # Large frames: one sendall per part — no megabyte concatenation
-        # (the writer is single-threaded per connection, so the parts
+        # (the writer serializes sends per connection, so the parts
         # cannot interleave).
         for p in parts:
             conn.sendall(p)
@@ -125,8 +273,8 @@ _EOF = object()
 
 
 def _recv_obj(conn: socket.socket) -> typing.Tuple[typing.Any, int]:
-    """Receive one frame; returns (object, payload_bytes) or (_EOF, 0)
-    on clean EOF at a frame boundary."""
+    """Blocking single-frame receive; ``(_EOF, 0)`` on clean EOF at a
+    frame boundary."""
     head = _recv_exact(conn, _FRAME_HDR.size)
     if head is None:
         return _EOF, 0
@@ -150,6 +298,215 @@ def _recv_obj(conn: socket.socket) -> typing.Tuple[typing.Any, int]:
     return pickle.loads(data, buffers=buffers), total
 
 
+class _ServerRoute:
+    """Per-connection receive state machine on the server's reactor.
+
+    Owns the handshake, the route's pending-element backlog (elements a
+    full gate could not take yet), the optional shm ring, and the EOP /
+    truncation bookkeeping.  All methods run ON the reactor thread
+    (space listeners re-enter through ``Reactor.submit``)."""
+
+    def __init__(self, server: "ShuffleServer", sock: socket.socket):
+        self.server = server
+        self.route = "<handshake>"
+        self.task: typing.Optional[str] = None
+        self.subtask_index = -1
+        self.channel_idx = -1
+        self.gate: typing.Optional["InputGate"] = None
+        self.is_control = False
+        self.pending: typing.Deque[typing.Any] = collections.deque()
+        self.ring: typing.Optional[ShmByteRing] = None
+        self._ring_parser = ShuffleFrameParser()
+        self.saw_eop = False
+        self.eof_clean: typing.Optional[bool] = None  # None = conn still open
+        self.done = False
+        self._records = self._bytes = None
+        self.conn = Connection(
+            server.reactor, sock,
+            parser=ShuffleFrameParser(),
+            on_message=self._on_message,
+            on_resume=self._drain,
+            on_eof=self._on_eof,
+            on_error=self._on_io_error,
+        )
+        server.reactor.add_connection(self.conn)
+
+    # -- frame handling (reactor thread) --------------------------------
+    def _on_message(self, item) -> bool:
+        obj, nbytes = item
+        if self.task is None:
+            return self._handshake(obj)
+        if self.is_control:
+            if self.server.on_control is not None:
+                self.server.on_control(self.subtask_index, obj)
+            return True
+        if obj == RING_NOTIFY:
+            return self._drain()
+        self._ingest(obj, nbytes)
+        return self._drain()
+
+    def _handshake(self, hello) -> bool:
+        self.task, self.subtask_index, self.channel_idx = hello[0], hello[1], hello[2]
+        self.route = f"{self.task}.{self.subtask_index}[ch{self.channel_idx}]"
+        if self.task == ShuffleServer.CONTROL_TASK:
+            # Coordinator control plane: subtask_index is the SENDER
+            # process; frames are opaque control messages.  EOF is a
+            # clean close (no EndOfPartition on control routes).
+            self.is_control = True
+            return True
+        gate = self.server._gates.get((self.task, self.subtask_index))
+        if gate is None:
+            raise ConnectionError(
+                f"no local gate for route {self.route} — placement mismatch "
+                "(peers must build the identical job graph)"
+            )
+        self.gate = gate
+        # Event-driven resume: when this gate frees space (or closes),
+        # re-enter on the reactor and continue delivery.
+        reactor = self.server.reactor
+        gate.add_space_listener(lambda: reactor.submit(self._kick))
+        if len(hello) > 3 and isinstance(hello[3], dict) and "shm" in hello[3]:
+            # Same-host upgrade: frames arrive over the shared ring; the
+            # socket stays as the notify/liveness channel.  The 5 ms
+            # poller is the doorbell-suppression liveness backstop (mmap
+            # stores are fence-free — see ShmByteRing's doorbell notes);
+            # it runs only while rings are attached.
+            self.ring = ShmByteRing.attach(hello[3]["shm"])
+            self.route += "[shm]"
+            self.server.reactor.add_poller(self._ring_poll, 0.005)
+        if self.server.metrics is not None:
+            # Scope includes the channel: the reactor is the single
+            # writer for these counters (Counter.inc is a plain += and
+            # must stay single-writer).
+            group = self.server.metrics.group(
+                f"shuffle.in.{self.task}.{self.subtask_index}.ch{self.channel_idx}")
+            self._records = group.counter("records")
+            self._bytes = group.counter("bytes")
+        return True
+
+    def _ingest(self, obj, nbytes: int) -> None:
+        """Expand one decoded frame into the pending backlog, counting
+        its record traffic (frames carrying only control elements do not
+        tick the record/byte counters — sender accounting mirrors this)."""
+        elements = expand_message(obj)
+        if self._records is not None:
+            n = sum(1 for e in elements if isinstance(e, el.StreamRecord))
+            if n:
+                self._records.inc(n)
+                self._bytes.inc(nbytes)
+        self.pending.extend(elements)
+
+    def _drain(self) -> bool:
+        """Deliver the pending backlog (and, in shm mode, the ring) into
+        the gate; False = stalled on a full gate (connection pauses)."""
+        while True:
+            while self.pending:
+                batch = list(self.pending)
+                taken = self.gate.try_put_batch(self.channel_idx, batch)
+                for element in batch[:taken]:
+                    self.pending.popleft()
+                    if type(element) is el.EndOfPartition:
+                        self.saw_eop = True
+                if taken < len(batch):
+                    return False
+            if self.ring is None:
+                return True
+            frame = self.ring.read()
+            if frame is None:
+                # Park-then-recheck: a frame published between the first
+                # read and the park would otherwise wait on a doorbell
+                # the sender suppressed.  The reactor's ring poller
+                # backstops the remaining fence-free mmap race.
+                self.ring.set_consumer_parked(True)
+                frame = self.ring.read()
+                if frame is None:
+                    return True
+                self.ring.set_consumer_parked(False)
+            for obj, nbytes in self._ring_parser.feed(frame):
+                if obj == RING_NOTIFY:
+                    continue
+                self._ingest(obj, nbytes)
+
+    def _kick(self) -> None:
+        """Gate-space wakeup (reactor thread): resume a paused
+        connection, or finish a post-EOF drain."""
+        if self.done:
+            return
+        if not self.conn.closed:
+            self.conn._do_resume()
+            return
+        if self._drain():
+            self._finish()
+
+    def _ring_poll(self) -> None:
+        """Reactor poller (ring routes only): drain frames whose
+        doorbell was lost to the park/publish race."""
+        if self.done or self.ring is None or not self.ring.readable():
+            return
+        self.ring.set_consumer_parked(False)
+        if self.conn.closed:
+            if self._drain():
+                self._finish()
+        elif self.conn._paused:
+            self.conn._do_resume()
+        else:
+            self._drain()
+
+    # -- teardown --------------------------------------------------------
+    def _on_eof(self, clean: bool) -> None:
+        self.eof_clean = clean
+        if not clean:
+            self._fail(ConnectionError(
+                f"peer for {self.route} closed mid-frame (stream truncated)"))
+            return
+        if self.is_control or self.gate is None:
+            self.done = True
+            return
+        if self._drain():
+            self._finish()
+        # else: backlog remains — the gate's space listener completes it.
+
+    def _finish(self) -> None:
+        if self.done:
+            return
+        self.done = True
+        if self.ring is not None:
+            self.server.reactor.remove_poller(self._ring_poll)
+            if self._ring_parser.buffered:
+                self._fail(ConnectionError(
+                    f"peer for {self.route} died mid-ring-frame "
+                    "(stream truncated)"), force=True)
+                return
+            self.ring.close(unlink=True)
+        if not self.saw_eop and not self.server._stop.is_set():
+            self._fail(ConnectionError(
+                f"peer for {self.route} disconnected before EndOfPartition "
+                "(upstream process lost)"), force=True)
+
+    def _on_io_error(self, exc: BaseException) -> None:
+        self._fail(exc)
+
+    def _fail(self, exc: BaseException, force: bool = False) -> None:
+        if self.done and not force:
+            return
+        self.done = True
+        if self.ring is not None:
+            self.server.reactor.remove_poller(self._ring_poll)
+            self.ring.close(unlink=True)
+        if not self.server._stop.is_set():
+            logger.error("shuffle reader %s failed", self.route, exc_info=exc)
+            if self.server.on_error is not None:
+                self.server.on_error(exc)
+        self.conn.close()
+
+    def close(self) -> None:
+        self.done = True
+        self.conn.close()
+        if self.ring is not None:
+            self.server.reactor.remove_poller(self._ring_poll)
+            self.ring.close(unlink=True)
+
+
 class ShuffleServer:
     """Per-process receiving endpoint of the record plane.
 
@@ -157,10 +514,13 @@ class ShuffleServer:
     owned before peers race to connect) -> ``register_gate`` for every
     local subtask during plan construction -> ``start`` -> ``close``.
 
-    A reader whose connection dies BEFORE delivering EndOfPartition
-    reports through ``on_error`` (the executor fails the job — upstream
-    process loss must surface as a failure, not as a silently truncated
-    stream); EOF after EOP is the clean shutdown.
+    All connections multiplex onto ONE reactor thread (owned here, or
+    shared when the executor passes its process-wide ``reactor``) —
+    there are no per-connection reader threads.  A connection that dies
+    BEFORE delivering EndOfPartition reports through ``on_error`` (the
+    executor fails the job — upstream process loss must surface as a
+    failure, not as a silently truncated stream); EOF after EOP is the
+    clean shutdown.
     """
 
     #: Handshake task name for coordinator control messages (checkpoint
@@ -170,7 +530,8 @@ class ShuffleServer:
     def __init__(self, bind: str = "0.0.0.0", port: int = 0, *,
                  on_error: typing.Optional[typing.Callable[[BaseException], None]] = None,
                  on_control: typing.Optional[typing.Callable[[int, typing.Any], None]] = None,
-                 metrics: typing.Optional[typing.Any] = None):
+                 metrics: typing.Optional[typing.Any] = None,
+                 reactor: typing.Optional[Reactor] = None):
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind, port))
@@ -179,157 +540,174 @@ class ShuffleServer:
         self.on_error = on_error
         self.on_control = on_control
         #: MetricRegistry for ingress traffic accounting (Flink's network
-        #: metrics analogue); counters are scoped per CHANNEL so each
-        #: reader thread owns its own (Counter.inc is not atomic).
+        #: metrics analogue); the reactor thread is the single writer.
         self.metrics = metrics
+        self.reactor = reactor if reactor is not None else Reactor(
+            name=f"shuffle-reactor:{self.port}")
+        self._own_reactor = reactor is None
         self._gates: typing.Dict[typing.Tuple[str, int], "InputGate"] = {}
-        self._threads: typing.List[threading.Thread] = []
-        self._conns: typing.List[socket.socket] = []
+        self._routes: typing.List[_ServerRoute] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._accept_thread: typing.Optional[threading.Thread] = None
 
     def register_gate(self, task: str, subtask_index: int, gate: "InputGate") -> None:
         self._gates[(task, subtask_index)] = gate
 
     def start(self) -> None:
-        self._listener.settimeout(0.25)
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"shuffle-accept:{self.port}", daemon=True
-        )
-        self._accept_thread.start()
+        self.reactor.start()
+        self.reactor.add_acceptor(self._listener, self._on_accept)
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return  # listener closed
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                if self._stop.is_set():
-                    conn.close()
-                    return
-                self._conns.append(conn)
-            t = threading.Thread(target=self._reader, args=(conn,), daemon=True)
-            t.start()
-            with self._lock:
-                self._threads.append(t)
-
-    def _reader(self, conn: socket.socket) -> None:
-        route = "<handshake>"
-        try:
-            hello, _ = _recv_obj(conn)
-            if hello is _EOF:
-                return  # peer probed and left before the handshake
-            task, subtask_index, channel_idx = hello
-            route = f"{task}.{subtask_index}[ch{channel_idx}]"
-            if task == self.CONTROL_TASK:
-                # Coordinator control plane: subtask_index is the SENDER
-                # process; frames are opaque control messages.  EOF is a
-                # clean close (no EndOfPartition on control routes).
-                while True:
-                    message, _ = _recv_obj(conn)
-                    if message is _EOF:
-                        return
-                    if self.on_control is not None:
-                        self.on_control(subtask_index, message)
-            gate = self._gates.get((task, subtask_index))
-            if gate is None:
-                raise ConnectionError(
-                    f"no local gate for route {route} — placement mismatch "
-                    "(peers must build the identical job graph)"
-                )
-            records = bytes_in = None
-            if self.metrics is not None:
-                # Scope includes the channel: one reader thread per
-                # connection = one writer per counter (Counter.inc is a
-                # plain += and must stay single-writer).
-                group = self.metrics.group(
-                    f"shuffle.in.{task}.{subtask_index}.ch{channel_idx}")
-                records, bytes_in = group.counter("records"), group.counter("bytes")
-            saw_eop = False
-            while True:
-                element, nbytes = _recv_obj(conn)
-                if element is _EOF:
-                    break
-                if records is not None and isinstance(element, el.StreamRecord):
-                    records.inc()
-                    bytes_in.inc(nbytes)
-                saw_eop = isinstance(element, el.EndOfPartition)
-                gate.put(channel_idx, element)
-            if not saw_eop and not self._stop.is_set():
-                raise ConnectionError(
-                    f"peer for {route} disconnected before EndOfPartition "
-                    "(upstream process lost)"
-                )
-        except BaseException as exc:  # noqa: BLE001 — relayed to the executor
-            if not self._stop.is_set():
-                logger.error("shuffle reader %s failed", route, exc_info=exc)
-                if self.on_error is not None:
-                    self.on_error(exc)
-        finally:
+    def _on_accept(self, conn: socket.socket) -> None:
+        if self._stop.is_set():
             conn.close()
+            return
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        route = _ServerRoute(self, conn)
+        with self._lock:
+            self._routes.append(route)
 
     def close(self, join: bool = True) -> None:
-        """``join=False`` skips waiting for reader threads — required when
-        closing from a reader thread itself (error path) where a join
-        would self-deadlock."""
+        """``join=False`` skips waiting for the reactor thread — required
+        when closing from a reactor callback itself (error path) where a
+        join would self-deadlock."""
         self._stop.set()
         try:
             self._listener.close()
         except OSError:
             pass
         with self._lock:
-            conns, self._conns = self._conns, []
-            threads, self._threads = self._threads, []
-        for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
-        if not join:
-            return
-        current = threading.current_thread()
-        if self._accept_thread is not None and self._accept_thread is not current:
-            self._accept_thread.join(timeout=2.0)
-        for t in threads:
-            if t is not current:
-                t.join(timeout=2.0)
+            routes, self._routes = self._routes, []
+        for route in routes:
+            route.close()
+        if self._own_reactor:
+            self.reactor.close(join=join)
+
+
+def _is_local_host(host: str) -> bool:
+    """Whether ``host`` names THIS machine (loopback or our hostname) —
+    the shm upgrade eligibility test.  Conservative: unknown names stay
+    on TCP."""
+    if host in ("127.0.0.1", "localhost", "::1", "0.0.0.0"):
+        return True
+    try:
+        return host == socket.gethostname()
+    except OSError:
+        return False
+
+
+def _estimate_record_bytes(value: typing.Any) -> int:
+    """Cheap payload-size estimate driving the size-threshold flush (the
+    exact frame size is only known after encoding, which is precisely
+    the work coalescing amortizes)."""
+    if isinstance(value, TensorValue):
+        return sum(a.nbytes for a in value.fields.values()) + 64
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes + 64
+    return 256
 
 
 class RemoteChannelWriter:
-    """ChannelWriter contract over TCP to a peer's ShuffleServer.
+    """ChannelWriter contract over TCP (or a same-host shm ring) to a
+    peer's ShuffleServer.
 
     One connection per writer = per (upstream subtask, downstream
     subtask, edge): per-channel FIFO for free.  Connects lazily on first
-    write with a retry window (cohort processes start in any order).
+    flush with a retry window (cohort processes start in any order).
     After ``close`` writes drop silently — the same teardown semantics
     as the in-process gate.
+
+    Coalescing: records buffer until ``flush_bytes`` of estimated
+    payload or ``flush_ms`` since the FIRST buffered record (the
+    process-wide :class:`FlushScheduler` fires the timeout), whichever
+    comes first; control elements (barrier / watermark / EOP) flush
+    everything buffered ahead of themselves and ship immediately, so
+    stream order and alignment semantics are byte-identical to the
+    per-record wire.  ``flush_bytes=0`` disables coalescing (the
+    pre-PR-8 frame-per-record wire).  A homogeneous flushed run encodes
+    columnar (serde.encode_batch, narrowed to ``wire_dtype`` when set);
+    heterogeneous runs pickle as one element list.
+
+    With a ``reactor``, sends enqueue on the connection's bounded send
+    queue and drain on the event loop (the subtask thread stops paying
+    the syscall); standalone writers (tests, control channels) keep the
+    blocking ``sendall`` path.  With ``shm=True`` and a same-host peer,
+    frames ride a tmpfs :class:`ShmByteRing` and the socket only carries
+    the handshake + ring notifies.
     """
 
     def __init__(self, host: str, port: int, task: str, subtask_index: int,
                  channel_idx: int, *, connect_timeout_s: float = 60.0,
-                 metrics: typing.Optional[typing.Any] = None):
+                 metrics: typing.Optional[typing.Any] = None,
+                 flush_bytes: typing.Optional[int] = None,
+                 flush_ms: typing.Optional[float] = None,
+                 columnar: bool = True,
+                 wire_dtype: typing.Optional[str] = None,
+                 reactor: typing.Optional[Reactor] = None,
+                 shm: bool = False,
+                 shm_ring_bytes: int = 8 << 20,
+                 tracer: typing.Optional[typing.Any] = None):
         self.host = host
         self.port = port
         self.task = task
         self.subtask_index = subtask_index
         self.channel_idx = channel_idx
         self.connect_timeout_s = connect_timeout_s
+        env_b, env_ms = env_flush_bytes(), env_flush_ms()
+        self.flush_bytes = (env_b if env_b is not None
+                            else flush_bytes if flush_bytes is not None
+                            else DEFAULT_FLUSH_BYTES)
+        self.flush_ms = (env_ms if env_ms is not None
+                         else flush_ms if flush_ms is not None
+                         else DEFAULT_FLUSH_MS)
+        self.columnar = columnar
+        self.wire_dtype = normalize_wire_dtype(wire_dtype)
+        self.shm = shm and _is_local_host(host)
+        self.shm_ring_bytes = shm_ring_bytes
+        self._reactor = reactor
+        self._tracer = tracer
+        #: Trace track: the edge's DESTINATION subtask — wire spans land
+        #: under the operator the frames feed, mirroring RemoteSink's
+        #: attribution (and the `<op>.<index>` shape the attribution
+        #: table requires).
+        self._track = f"{task}.{subtask_index}"
         self._sock: typing.Optional[socket.socket] = None
+        self._conn: typing.Optional[Connection] = None
+        self._ring: typing.Optional[ShmByteRing] = None
         self._closed = False
+        self._lock = threading.RLock()
+        #: A flush that failed OFF the writing thread (buffer-timeout
+        #: fires on the shared FlushScheduler) parks its error here; the
+        #: next write() re-raises it so peer loss still surfaces as THIS
+        #: subtask's failure, exactly like the old blocking sendall.
+        self._error: typing.Optional[BaseException] = None
+        self._buf: typing.List[el.StreamRecord] = []
+        self._buf_bytes = 0
+        self._buf_t0 = 0.0
+        self._timer_armed = False
         self._records = self._bytes = None
+        self._flush_counters = None
+        self._frame_records = self._frame_bytes = None
+        self._flush_total = None
         if metrics is not None:
-            # Per-channel scope: each writer (one upstream subtask
-            # thread) owns its counters — Counter.inc is not atomic.
+            # Per-channel scope: every flush runs under this writer's
+            # lock, so the counters stay effectively single-writer
+            # (subtask thread and flush timer serialize on it).
             group = metrics.group(
                 f"shuffle.out.{task}.{subtask_index}.ch{channel_idx}")
             self._records = group.counter("records")
             self._bytes = group.counter("bytes")
+            self._flush_counters = {
+                reason: group.counter(f"flush_{reason}")
+                for reason in ("size", "timeout", "barrier", "close")
+            }
+            self._frame_records = group.histogram("frame_records")
+            self._frame_bytes = group.histogram("frame_bytes")
+            # Job-wide flush meter (Meter is thread-safe): one rate for
+            # the whole plane, reasons attributed per edge above.
+            self._flush_total = metrics.group("wire").meter("flush_total")
 
+    # -- connection ------------------------------------------------------
     def _connect(self) -> None:
         deadline = time.monotonic() + self.connect_timeout_s
         while True:
@@ -359,34 +737,240 @@ class RemoteChannelWriter:
                 time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_obj(self._sock, (self.task, self.subtask_index, self.channel_idx))
+        hello: typing.Tuple = (self.task, self.subtask_index, self.channel_idx)
+        if self.shm:
+            path = os.path.join(
+                shm_dir(),
+                f"ftt-ring-{self.port}-{os.getpid()}-"
+                f"{abs(hash((self.task, self.subtask_index, self.channel_idx))) % (1 << 32):08x}",
+            )
+            self._ring = ShmByteRing.create(path, self.shm_ring_bytes)
+            hello = hello + ({"shm": path, "capacity": self._ring.capacity},)
+        _send_obj(self._sock, hello)
+        if self._reactor is not None and self._ring is None:
+            # Async sends: the reactor drains a bounded queue; errors
+            # surface on the next write through the stored exception.
+            self._conn = Connection(self._reactor, self._sock)
+            self._reactor.add_connection(self._conn)
 
+    # -- write path ------------------------------------------------------
     def write(self, element: el.StreamElement) -> None:
         if self._closed:
             return  # job torn down: drop, like InputGate.put after close
-        if self._sock is None:
-            self._connect()
+        with self._lock:
+            if self._closed:
+                return
+            if self._error is not None:
+                exc, self._error = self._error, None
+                raise exc
+            if self._sock is None:
+                # Connect on the WRITING thread (cohort-startup retries
+                # must not stall the shared flush timer for every other
+                # edge in the process).
+                self._connect()
+            if type(element) is el.StreamRecord and self.flush_bytes > 0:
+                self._buf.append(element)
+                self._buf_bytes += _estimate_record_bytes(element.value)
+                if len(self._buf) == 1:
+                    self._buf_t0 = time.monotonic()
+                    if self.flush_ms > 0 and not self._timer_armed:
+                        # ONE pending deadline per writer, re-armed from
+                        # the timer thread itself — not one per buffered
+                        # epoch.  The hot write path therefore never
+                        # wakes the timer (schedule() only notifies for
+                        # earlier deadlines).
+                        self._timer_armed = True
+                        FlushScheduler.shared().schedule(
+                            self._buf_t0 + self.flush_ms / 1e3,
+                            self._timer_fire)
+                if self._buf_bytes >= self.flush_bytes:
+                    self._flush_locked("size")
+                elif self.flush_ms <= 0:
+                    # bufferTimeout=0 semantics: flush every record.
+                    self._flush_locked("timeout")
+                return
+            # Control elements (and the no-coalescing mode): everything
+            # buffered goes out FIRST — stream order is preserved, and a
+            # barrier never waits out the buffer timeout behind it.
+            if isinstance(element, (el.CheckpointBarrier, el.Watermark)):
+                self._flush_locked("barrier")
+            else:
+                self._flush_locked("close"
+                                   if isinstance(element, el.EndOfPartition)
+                                   else "size")
+            self._send_one(element)
+
+    def _timer_fire(self) -> None:
+        """Buffer-timeout callback (FlushScheduler thread).  Re-arms
+        itself towards the CURRENT buffer's deadline while records keep
+        flowing; disarms when the writer idles or closes (the next first
+        buffered record re-arms)."""
+        with self._lock:
+            if self._closed or not self._buf:
+                self._timer_armed = False
+                return  # torn down, or flushed by size with no refill
+            due = self._buf_t0 + self.flush_ms / 1e3
+            if time.monotonic() + 1e-4 < due:
+                # The buffer was size-flushed and refilled since arming:
+                # this deadline belongs to an older epoch — sleep on.
+                FlushScheduler.shared().schedule(due, self._timer_fire)
+                return
+            self._timer_armed = False
+            try:
+                self._flush_locked("timeout")
+            except (OSError, ConnectionError, TimeoutError) as exc:
+                # Off-thread failure: defer to the next write() so the
+                # OWNING subtask fails the job, not the shared timer.
+                self._error = exc
+
+    def _flush_locked(self, reason: str) -> None:
+        buf = self._buf
+        if not buf:
+            return
+        self._buf = []
+        self._buf_bytes = 0
+        t_first = self._buf_t0
+        n = len(buf)
+        t0 = time.monotonic()
+        if n == 1:
+            obj: typing.Any = buf[0]
+        else:
+            obj = self._coalesce(buf)
+        parts, payload_bytes = encode_obj_frame(obj)
+        t1 = time.monotonic()
+        self._send_parts(parts, payload_bytes)
+        t2 = time.monotonic()
+        if self._records is not None:
+            self._records.inc(n)
+            self._bytes.inc(payload_bytes)
+            self._flush_counters[reason].inc()
+            self._frame_records.record(n)
+            self._frame_bytes.record(payload_bytes)
+            self._flush_total.mark()
+        tracer = self._tracer
+        if tracer is not None:
+            # Coalescing delay (first buffered record -> flush) lands
+            # separately from serde and the send itself, so the trace
+            # CLI attributes buffer-timeout latency distinctly.
+            tracer.span(self._track, "wire.flush", t_first, t0,
+                        args={"reason": reason, "records": n})
+            tracer.span(self._track, "serde", t0, t1,
+                        args={"bytes": payload_bytes, "records": n})
+            tracer.span(self._track, "wire", t1, t2,
+                        args={"bytes": payload_bytes})
+
+    def _coalesce(self, buf: typing.List[el.StreamRecord]) -> typing.Any:
+        """Shape one flushed run: columnar when every record is a
+        homogeneous TensorValue, else the pickled element list."""
+        if self.columnar:
+            sig = batch_signature(buf[0].value)
+            if sig is not None and all(
+                    batch_signature(r.value) == sig for r in buf[1:]):
+                values = [r.value for r in buf]
+                payload = encode_batch(values, self.wire_dtype)
+                timestamps = ([r.timestamp for r in buf]
+                              if any(r.timestamp is not None for r in buf)
+                              else None)
+                traces = ([r.trace for r in buf]
+                          if any(r.trace is not None for r in buf)
+                          else None)
+                return ColumnarFrame(
+                    np.frombuffer(payload, np.uint8), timestamps, traces)
+        return buf
+
+    def _send_one(self, element: typing.Any) -> None:
+        t0 = time.monotonic()
+        parts, payload_bytes = encode_obj_frame(element)
+        t1 = time.monotonic()
+        self._send_parts(parts, payload_bytes)
+        if self._records is not None and isinstance(element, el.StreamRecord):
+            self._records.inc()
+            self._bytes.inc(payload_bytes)
+        tracer = self._tracer
+        if tracer is not None and isinstance(element, el.StreamRecord):
+            # Span parity with the coalesced path (minus wire.flush —
+            # nothing buffers), so per-record vs coalesced wires compare
+            # directly in the attribution table.
+            t2 = time.monotonic()
+            tracer.span(self._track, "serde", t0, t1,
+                        args={"bytes": payload_bytes, "records": 1})
+            tracer.span(self._track, "wire", t1, t2,
+                        args={"bytes": payload_bytes})
+
+    def _send_parts(self, parts, payload_bytes: int) -> None:
         try:
-            nbytes = _send_obj(self._sock, element)
-            if self._records is not None and isinstance(element, el.StreamRecord):
-                self._records.inc()
-                self._bytes.inc(nbytes)
-        except OSError:
-            # Drop the dead socket so a LATER write reconnects instead of
-            # failing forever on the cached fd (control writers are
+            if self._sock is None:
+                self._connect()
+            if self._ring is not None:
+                total = sum(
+                    p.nbytes if isinstance(p, memoryview) else len(p)
+                    for p in parts)
+                while not self._ring.try_write_parts(parts, total):
+                    # Ring full = same-host backpressure: back off until
+                    # the consumer drains (its gate freed space) or the
+                    # job tears down.
+                    if self._closed:
+                        return
+                    time.sleep(0.0001)
+                # Doorbell suppression: ring the socket only when the
+                # consumer declared itself parked — a draining consumer
+                # sees the published tail without any syscall at all.
+                # (The receiver keeps a bounded ring re-poll, so the
+                # fence-free park/publish race cannot strand frames.)
+                if self._ring.consumer_parked():
+                    self._ring.set_consumer_parked(False)
+                    self._sock.sendall(_ring_notify_wire())
+            elif self._conn is not None:
+                self._conn.send(parts)
+            else:
+                _sendall_parts(self._sock, parts)
+        except (OSError, ConnectionError):
+            # Drop the dead transport so a LATER write reconnects instead
+            # of failing forever on the cached fd (control writers are
             # long-lived across checkpoints; a transient reset must not
             # wedge every subsequent commit gate).
+            self._teardown_transport()
+            if self._closed:
+                return
+            raise  # peer loss surfaces as subtask failure -> job failure
+
+    def _teardown_transport(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+        if self._ring is not None:
+            self._ring.close(unlink=True)
+            self._ring = None
+        if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
-            if self._closed:
-                return
-            raise  # peer loss surfaces as subtask failure -> job failure
 
     def close(self) -> None:
         self._closed = True
+        # Buffered records are dropped, matching the pre-coalescing
+        # teardown semantics: a clean stream ends with EndOfPartition
+        # (which force-flushed everything ahead of it), so anything
+        # still buffered here belongs to a cancelled job.
+        acquired = self._lock.acquire(timeout=2.0)
+        try:
+            self._buf = []
+            self._buf_bytes = 0
+        finally:
+            if acquired:
+                self._lock.release()
+        if self._conn is not None:
+            self._conn.drain(timeout=2.0)
+            self._conn.close()
+            self._conn = None
+        if self._ring is not None:
+            # Give the receiver a moment to drain, then drop our mapping
+            # (the receiver unlinks; unlink here is a crash backstop for
+            # a peer that never attached).
+            self._ring.close()
+            self._ring = None
         if self._sock is not None:
             try:
                 self._sock.shutdown(socket.SHUT_WR)

@@ -196,12 +196,24 @@ class CompiledMethodRunner:
         # dtype is a no-op.  Dynamic-length fields keep their pad dtype.
         restore = {n: schema[n].dtype for n in schema.names}
 
+        from flink_tensorflow_tpu.tensors.transfer import is_scale_key, scale_key
+
         def widen(inputs):
-            return {
-                k: (v.astype(restore[k])
-                    if k in restore and v.dtype != restore[k] else v)
-                for k, v in inputs.items()
-            }
+            # Restores the declared dtype as the FIRST (fused) op of the
+            # jitted call; int8-narrowed fields also multiply their
+            # absmax scale back in (the companion __scale__ inputs ride
+            # the same device_put pytree and never reach the model).
+            out = {}
+            for k, v in inputs.items():
+                if is_scale_key(k):
+                    continue
+                if k in restore and v.dtype != restore[k]:
+                    v = v.astype(restore[k])
+                    scale = inputs.get(scale_key(k))
+                    if scale is not None:
+                        v = v * scale
+                out[k] = v
+            return out
 
         def prune(outputs):
             if select is None:

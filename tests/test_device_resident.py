@@ -174,6 +174,47 @@ class TestDeviceBatch:
             full.close()
             narrow.close()
 
+    def test_wire_dtype_int8_quarters_h2d_bytes(self):
+        """PR-7 deferral closed: int8 absmax narrowing on the h2d hop —
+        the field ships quantized with a companion __scale__ input, and
+        the jitted call dequantizes as its first (fused) op."""
+        from flink_tensorflow_tpu.tensors.batching import assemble
+
+        model = _res_model()
+        full = _runner(model)
+        narrow = _runner(model, wire_dtype="int8")
+        try:
+            recs = _records(4)
+            a = full.run_batch(recs)
+            b = narrow.run_batch(recs)
+            # absmax quantization: input error <= absmax/254 + rounding;
+            # tanh(x@w)+x with |w|~0.1 keeps the amplification ~O(1).
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(x["x"], y["x"], atol=0.02)
+            batch_bytes = 4 * DIM * 4
+            arrays, nb, saved = narrow._transfer.ship(assemble(
+                recs, model.method("serve").input_schema, narrow.policy))
+            # 1/4 payload + one f32 scale scalar alongside the field.
+            assert nb == batch_bytes // 4 + 4
+            assert saved == batch_bytes * 3 // 4
+            assert "__scale__x" in arrays
+        finally:
+            full.close()
+            narrow.close()
+
+    def test_wire_dtype_f16_h2d_tolerance(self):
+        model = _res_model()
+        full = _runner(model)
+        narrow = _runner(model, wire_dtype="f16")
+        try:
+            recs = _records(4)
+            for x, y in zip(full.run_batch(recs), narrow.run_batch(recs)):
+                np.testing.assert_allclose(x["x"], y["x"],
+                                           rtol=2 ** -9, atol=1e-3)
+        finally:
+            full.close()
+            narrow.close()
+
 
 def _chain_env(device_resident, records, trace=False, micro=4,
                ckpt_dir=None, every_n=None, throttle=0.0):

@@ -1,0 +1,595 @@
+"""High-throughput record plane (ISSUE 8): frame coalescing, the
+selectors-based reactor, the columnar serde fast path, and same-host
+shared-memory channels.
+
+The framing edge cases the coalesced plane must pin:
+
+- stream order and barrier alignment are byte-identical to the
+  per-record wire (control elements force a flush ahead of themselves);
+- peer death mid-coalesced-frame raises (no silent truncation — a lost
+  half-frame must never pass as a clean close);
+- decoded out-of-band buffers stay WRITABLE (in-place user code must
+  not break only in distributed runs);
+- the shm ring carries exactly the TCP frames and cleans up its tmpfs
+  file;
+- the sanitizer reports zero violations on the reactor paths.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu.core import elements as el
+from flink_tensorflow_tpu.core.channels import InputGate
+from flink_tensorflow_tpu.core.shuffle import (
+    ColumnarFrame,
+    RemoteChannelWriter,
+    ShuffleServer,
+    _send_obj,
+    encode_obj_frame,
+)
+from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+from flink_tensorflow_tpu.native.ring import ShmByteRing, shm_dir
+from flink_tensorflow_tpu.tensors import TensorValue
+
+
+def _tv(i, n=16):
+    return TensorValue({"x": np.full(n, i, np.float32)}, {"i": i})
+
+
+def _server(gate, metrics=None, **kw):
+    server = ShuffleServer("127.0.0.1", metrics=metrics, **kw)
+    server.register_gate("op", 0, gate)
+    server.start()
+    return server
+
+
+def _writer(port, metrics=None, **kw):
+    return RemoteChannelWriter("127.0.0.1", port, "op", 0, 0,
+                               connect_timeout_s=10.0, metrics=metrics, **kw)
+
+
+def _drain(gate, n, timeout=15.0):
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n and time.monotonic() < deadline:
+        item = gate.poll(timeout=0.5)
+        if item is not None:
+            out.append(item[1])
+    return out
+
+
+def _await_metric(reg, key, want, timeout=5.0):
+    """Sender-side counters tick right AFTER the send; the receiver can
+    deliver first — wait the metric out instead of racing it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if reg.report().get(key) == want:
+            return reg.report()
+        time.sleep(0.01)
+    return reg.report()
+
+
+class TestCoalescing:
+    def test_barrier_forces_flush_order_preserved(self):
+        """Acceptance: barrier-through-coalesced-frame.  Records buffered
+        ahead of a barrier flush BEFORE it; records after it stay after —
+        alignment sees exactly the per-record wire's stream order."""
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=1 << 20, flush_ms=10_000.0)
+        try:
+            for i in range(5):
+                w.write(el.StreamRecord(_tv(i)))
+            w.write(el.CheckpointBarrier(1))
+            for i in range(5, 8):
+                w.write(el.StreamRecord(_tv(i)))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 10)
+            kinds = [type(e) for e in got]
+            assert kinds == [el.StreamRecord] * 5 + [el.CheckpointBarrier] \
+                + [el.StreamRecord] * 3 + [el.EndOfPartition]
+            assert [e.value.meta["i"] for e in got[:5]] == list(range(5))
+            assert got[5].checkpoint_id == 1
+            assert [e.value.meta["i"] for e in got[6:9]] == [5, 6, 7]
+        finally:
+            w.close()
+            server.close()
+
+    def test_flush_reason_attribution(self):
+        reg = MetricRegistry()
+        gate = InputGate(1, capacity=256)
+        server = _server(gate, metrics=reg)
+        w = _writer(server.port, metrics=reg,
+                    flush_bytes=2_000, flush_ms=10_000.0)
+        try:
+            # ~16*4+64 bytes estimated per record: >= 2000 flushes on size.
+            for i in range(40):
+                w.write(el.StreamRecord(_tv(i)))
+            w.write(el.CheckpointBarrier(1))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 42)
+            assert len(got) == 42
+            scope = "shuffle.out.op.0.ch0"
+            report = _await_metric(reg, f"{scope}.records", 40)
+            assert report[f"{scope}.flush_size"] >= 1
+            assert report[f"{scope}.flush_barrier"] >= 1
+            assert report[f"{scope}.records"] == 40
+            assert report["shuffle.in.op.0.ch0.records"] == 40
+            assert (report[f"{scope}.bytes"]
+                    == report["shuffle.in.op.0.ch0.bytes"] > 0)
+            assert report["wire.flush_total"]["count"] >= 2
+        finally:
+            w.close()
+            server.close()
+
+    def test_timeout_flush(self):
+        reg = MetricRegistry()
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, metrics=reg,
+                    flush_bytes=1 << 20, flush_ms=20.0)
+        try:
+            for i in range(3):
+                w.write(el.StreamRecord(_tv(i)))
+            # Nothing else forces a flush: only the buffer timeout can
+            # deliver these.
+            got = _drain(gate, 3)
+            assert [e.value.meta["i"] for e in got] == [0, 1, 2]
+            report = _await_metric(
+                reg, "shuffle.out.op.0.ch0.flush_timeout", 1)
+            assert report["shuffle.out.op.0.ch0.flush_timeout"] == 1
+        finally:
+            w.close()
+            server.close()
+
+    def test_timeout_flush_rearms_across_idle_gaps(self):
+        """The buffer timer is ONE re-arming deadline per writer (not
+        one per epoch): after a timeout flush disarms it, the next first
+        buffered record must re-arm it — a record written after an idle
+        gap still flushes within ~flush_ms, repeatedly."""
+        reg = MetricRegistry()
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, metrics=reg,
+                    flush_bytes=1 << 20, flush_ms=10.0)
+        try:
+            for i in range(3):
+                w.write(el.StreamRecord(_tv(i)))
+                got = _drain(gate, 1)
+                assert [e.value.meta["i"] for e in got] == [i]
+                time.sleep(0.05)  # idle past the deadline between writes
+            report = _await_metric(
+                reg, "shuffle.out.op.0.ch0.flush_timeout", 3)
+            assert report["shuffle.out.op.0.ch0.flush_timeout"] == 3
+        finally:
+            w.close()
+            server.close()
+
+    def test_coalescing_disabled_is_frame_per_record(self):
+        reg = MetricRegistry()
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, metrics=reg, flush_bytes=0)
+        try:
+            for i in range(4):
+                w.write(el.StreamRecord(_tv(i)))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 5)
+            assert len(got) == 5
+        finally:
+            w.close()
+            server.close()
+
+    def test_columnar_roundtrip_with_timestamps(self):
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=1 << 20, flush_ms=10_000.0)
+        try:
+            for i in range(6):
+                w.write(el.StreamRecord(_tv(i), timestamp=0.5 * i))
+            # White-box: a homogeneous run coalesces columnar.
+            assert isinstance(w._coalesce(
+                [el.StreamRecord(_tv(i)) for i in range(3)]), ColumnarFrame)
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 7)
+            recs = got[:6]
+            assert all(isinstance(e, el.StreamRecord) for e in recs)
+            for i, e in enumerate(recs):
+                assert e.timestamp == 0.5 * i
+                assert e.value.meta["i"] == i
+                np.testing.assert_array_equal(
+                    e.value["x"], np.full(16, i, np.float32))
+        finally:
+            w.close()
+            server.close()
+
+    def test_heterogeneous_run_falls_back_to_list(self):
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=1 << 20, flush_ms=10_000.0)
+        try:
+            # Mixed shapes + a plain-int record: not columnar-eligible.
+            assert not isinstance(w._coalesce(
+                [el.StreamRecord(_tv(0)), el.StreamRecord(7)]), ColumnarFrame)
+            w.write(el.StreamRecord(_tv(0)))
+            w.write(el.StreamRecord(7))
+            w.write(el.StreamRecord(TensorValue(
+                {"y": np.ones((2, 2), np.float64)}, {"i": 2})))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 4)
+            assert got[0].value == _tv(0)
+            assert got[1].value == 7
+            assert got[2].value.meta["i"] == 2
+        finally:
+            w.close()
+            server.close()
+
+    def test_columnar_narrowed_wire_dtype(self):
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=1 << 20, flush_ms=10_000.0,
+                    wire_dtype="bf16")
+        try:
+            vals = [TensorValue(
+                {"x": (np.arange(16, dtype=np.float32) - 8) * (i + 1)},
+                {"i": i}) for i in range(4)]
+            for v in vals:
+                w.write(el.StreamRecord(v))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 5)
+            for v, e in zip(vals, got[:4]):
+                assert e.value["x"].dtype == np.float32
+                np.testing.assert_allclose(e.value["x"], v["x"],
+                                           rtol=2 ** -7, atol=1e-6)
+        finally:
+            w.close()
+            server.close()
+
+    def test_decoded_oob_buffers_are_writable(self):
+        """The mutable-buffer guarantee survives coalescing: numpy
+        payloads reconstructed from a coalesced pickle frame's
+        out-of-band buffers must be writable (in-place user code)."""
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=1 << 20, flush_ms=10_000.0)
+        try:
+            # Plain dict values (NOT TensorValue, whose contract is
+            # immutability): arrays ride pickle-5 out-of-band.
+            w.write(el.StreamRecord({"x": np.arange(1000, dtype=np.float32)}))
+            w.write(el.StreamRecord({"x": np.ones(500, np.float32)}))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 3)
+            for e in got[:2]:
+                arr = e.value["x"]
+                assert arr.flags.writeable
+                arr += 1.0  # must not raise
+        finally:
+            w.close()
+            server.close()
+
+
+class TestTruncation:
+    def _raw_conn(self, port):
+        s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+        _send_obj(s, ("op", 0, 0))
+        return s
+
+    def test_peer_death_mid_coalesced_frame_raises(self):
+        """EOF inside a half-received coalesced frame is a loud
+        transport error, never a silently truncated stream."""
+        errors = []
+        gate = InputGate(1)
+        server = _server(gate, on_error=errors.append)
+        try:
+            s = self._raw_conn(server.port)
+            parts, _ = encode_obj_frame(
+                [el.StreamRecord(_tv(i)) for i in range(8)])
+            frame = b"".join(bytes(p) for p in parts)
+            s.sendall(frame[: len(frame) - 11])  # die mid-frame
+            s.close()
+            deadline = time.monotonic() + 10.0
+            while not errors and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert errors, "mid-frame truncation was not reported"
+            assert "truncat" in str(errors[0]) or "mid-frame" in str(errors[0])
+        finally:
+            server.close()
+
+    def test_clean_eof_without_eop_is_peer_loss(self):
+        errors = []
+        gate = InputGate(1)
+        server = _server(gate, on_error=errors.append)
+        try:
+            s = self._raw_conn(server.port)
+            _send_obj(s, el.StreamRecord(_tv(1)))
+            s.close()  # frame boundary, but no EndOfPartition
+            deadline = time.monotonic() + 10.0
+            while not errors and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert errors and "EndOfPartition" in str(errors[0])
+        finally:
+            server.close()
+
+
+class TestBackpressure:
+    def test_full_gate_pauses_and_resumes_lossless(self):
+        """A tiny gate forces the reactor through its pause/resume path
+        hundreds of times; every record must arrive exactly once, in
+        order (the event-driven resume must not lose or reorder)."""
+        gate = InputGate(1, capacity=4)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=600, flush_ms=2.0)
+        n = 300
+
+        def produce():
+            for i in range(n):
+                w.write(el.StreamRecord(_tv(i, n=8)))
+            w.write(el.EndOfPartition())
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            got = []
+            deadline = time.monotonic() + 30.0
+            while len(got) < n + 1 and time.monotonic() < deadline:
+                item = gate.poll(timeout=0.5)
+                if item is None:
+                    continue
+                got.append(item[1])
+                time.sleep(0.0005)  # slow consumer: keeps the gate full
+            assert len(got) == n + 1
+            ids = [e.value.meta["i"] for e in got[:-1]]
+            assert ids == list(range(n))
+            assert isinstance(got[-1], el.EndOfPartition)
+        finally:
+            t.join(timeout=5)
+            w.close()
+            server.close()
+
+
+class TestShmChannel:
+    def test_same_host_edge_rides_the_ring(self):
+        gate = InputGate(1, capacity=256)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=4_000, flush_ms=5.0, shm=True)
+        try:
+            for i in range(100):
+                w.write(el.StreamRecord(_tv(i)))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 101)
+            assert len(got) == 101
+            assert [e.value.meta["i"] for e in got[:-1]] == list(range(100))
+            # The transport really was the ring, and its tmpfs file is
+            # unlinked once the receiver saw the clean EOF after EOP.
+            assert w._ring is not None
+            path = w._ring.path
+            assert os.path.exists(path)
+            w.close()
+            deadline = time.monotonic() + 5.0
+            while os.path.exists(path) and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not os.path.exists(path)
+        finally:
+            w.close()
+            server.close()
+
+    def test_barriers_and_watermarks_cross_the_ring(self):
+        gate = InputGate(1, capacity=64)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=1 << 20, flush_ms=10_000.0,
+                    shm=True)
+        try:
+            w.write(el.StreamRecord(_tv(0)))
+            w.write(el.Watermark(1.5))
+            w.write(el.CheckpointBarrier(3))
+            w.write(el.StreamRecord(_tv(1)))
+            w.write(el.EndOfPartition())
+            got = _drain(gate, 5)
+            assert [type(e) for e in got] == [
+                el.StreamRecord, el.Watermark, el.CheckpointBarrier,
+                el.StreamRecord, el.EndOfPartition]
+            assert got[1].timestamp == 1.5 and got[2].checkpoint_id == 3
+        finally:
+            w.close()
+            server.close()
+
+    def test_shm_requires_local_host(self):
+        w = RemoteChannelWriter("198.51.100.7", 1, "op", 0, 0, shm=True)
+        assert not w.shm  # non-local peer: silently stays on TCP
+
+    def test_doorbell_suppressed_wakes_after_idle_gaps(self):
+        """Doorbell suppression: the sender rings the socket only for a
+        PARKED consumer.  Bursts separated by idle gaps (consumer parks
+        between them) must each wake the receiver — and a burst landing
+        while the consumer drains must arrive without its own doorbell
+        (suppressed count observable via the parked flag protocol)."""
+        gate = InputGate(1, capacity=256)
+        server = _server(gate)
+        w = _writer(server.port, flush_bytes=64, flush_ms=2.0, shm=True)
+        try:
+            total = 0
+            for burst in range(5):
+                for i in range(10):
+                    w.write(el.StreamRecord(_tv(total + i)))
+                total += 10
+                got = _drain(gate, 10)
+                assert [e.value.meta["i"] for e in got] == list(
+                    range(total - 10, total))
+                # Consumer drained dry -> it parked itself; the next
+                # burst's first frame must ring the doorbell (or the
+                # reactor poller backstop must catch it).
+                time.sleep(0.03)
+                assert w._ring is not None and w._ring.consumer_parked()
+            w.write(el.EndOfPartition())
+            assert len(_drain(gate, 1)) == 1
+        finally:
+            w.close()
+            server.close()
+
+
+class TestShmByteRing:
+    def test_wraparound_parity(self):
+        path = os.path.join(shm_dir(), f"ftt-test-ring-{os.getpid()}-a")
+        prod = ShmByteRing.create(path, 1 << 12)
+        cons = ShmByteRing.attach(path)
+        try:
+            rng = np.random.RandomState(3)
+            frames = [bytes(rng.randint(0, 256, rng.randint(1, 900),
+                                        dtype=np.uint8)) for _ in range(300)]
+            got, pending, it = [], None, iter(frames)
+            while len(got) < len(frames):
+                if pending is None:
+                    pending = next(it, None)
+                if pending is not None and prod.try_write(pending):
+                    pending = None
+                frame = cons.read()
+                if frame is not None:
+                    got.append(bytes(frame))
+            assert got == frames
+        finally:
+            cons.close(unlink=True)
+            prod.close()
+        assert not os.path.exists(path)
+
+    def test_oversized_frame_rejected(self):
+        path = os.path.join(shm_dir(), f"ftt-test-ring-{os.getpid()}-b")
+        ring = ShmByteRing.create(path, 1 << 10)
+        try:
+            with pytest.raises(ValueError, match="exceeds"):
+                ring.try_write(b"x" * (1 << 11))
+        finally:
+            ring.close(unlink=True)
+
+    def test_full_ring_reports_false(self):
+        path = os.path.join(shm_dir(), f"ftt-test-ring-{os.getpid()}-c")
+        ring = ShmByteRing.create(path, 1 << 10)
+        try:
+            writes = 0
+            while ring.try_write(b"y" * 100):
+                writes += 1
+            assert 0 < writes <= (1 << 10) // 104 + 1
+            ring.read()
+            assert ring.try_write(b"y" * 100)  # space reclaimed
+        finally:
+            ring.close(unlink=True)
+
+
+from flink_tensorflow_tpu.core import functions as fn  # noqa: E402
+
+
+class _Doubler(fn.ProcessFunction):
+    def process_element(self, value, ctx, out):
+        out.collect(TensorValue({"v": value["v"] * 2},
+                                {"key": int(value.meta["key"])}))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TestSanitizerCleanOnReactorPaths:
+    def test_two_process_cohort_in_threads_zero_violations(self):
+        """Acceptance: FLINK_TPU_SANITIZE semantics (JobConfig.sanitize)
+        report zero violations with the reactor receive path feeding
+        instrumented gates.  Two cohort 'processes' run as threads in
+        this process — real TCP/shm channels, real barriers."""
+        from flink_tensorflow_tpu import (
+            DistributedConfig,
+            StreamExecutionEnvironment,
+        )
+
+        ports = _free_ports(2)
+        peers = tuple(f"127.0.0.1:{p}" for p in ports)
+        n, num_keys = 120, 4
+        outs = {0: [], 1: []}
+        errors = []
+
+        def run(proc):
+            try:
+                env = StreamExecutionEnvironment(parallelism=1)
+                env.set_distributed(DistributedConfig(proc, 2, peers))
+                env.configure(sanitize=True)
+                records = [
+                    TensorValue({"v": np.int64(i)}, {"key": i % num_keys})
+                    for i in range(n)
+                ]
+                collected = (
+                    env.from_collection(records, parallelism=1)
+                    .key_by(lambda r: int(r.meta["key"]))
+                    .process(_Doubler(), name="bump", parallelism=2)
+                    .sink_to_list(parallelism=2)
+                )
+                env.execute(timeout=90)
+                outs[proc].extend(collected)
+            except BaseException as exc:  # noqa: BLE001
+                errors.append((proc, exc))
+
+        threads = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, f"cohort failed under sanitizer: {errors}"
+        got = sorted(int(v["v"]) for v in outs[0] + outs[1])
+        assert got == sorted(2 * i for i in range(n))
+
+
+class TestRemoteSinkCoalescing:
+    def _pipe(self, sink_kwargs, n=60):
+        from flink_tensorflow_tpu import StreamExecutionEnvironment
+        from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+
+        source = RemoteSource(bind="127.0.0.1")
+        records = [
+            TensorValue({"x": np.full(8, i, np.float32)}, {"i": i})
+            for i in range(n)
+        ]
+
+        def upstream():
+            env = StreamExecutionEnvironment(parallelism=1)
+            (
+                env.from_collection(records)
+                .add_sink(RemoteSink("127.0.0.1", source.port, **sink_kwargs))
+            )
+            env.execute(timeout=60)
+
+        t = threading.Thread(target=upstream)
+        t.start()
+        env2 = StreamExecutionEnvironment(parallelism=1)
+        out = env2.from_source(source).sink_to_list()
+        env2.execute(timeout=60)
+        t.join()
+        assert [r.meta["i"] for r in out] == list(range(n))
+        return out, records
+
+    def test_coalesced_columnar_pipe(self):
+        out, records = self._pipe(dict(flush_bytes=2_000, flush_ms=50.0))
+        for got, want in zip(out, records):
+            np.testing.assert_array_equal(got["x"], want["x"])
+
+    def test_flush_ms_zero_is_per_record(self):
+        self._pipe(dict(flush_ms=0.0))
+
+    def test_close_flushes_partial_buffer(self):
+        # Huge thresholds: ONLY the sink's close() can deliver these.
+        self._pipe(dict(flush_bytes=1 << 30, flush_ms=10_000.0), n=10)
+
+    def test_narrowed_columnar_pipe(self):
+        out, records = self._pipe(
+            dict(flush_bytes=2_000, flush_ms=50.0, wire_dtype="bf16"))
+        for got, want in zip(out, records):
+            np.testing.assert_allclose(got["x"], want["x"], rtol=2 ** -7,
+                                       atol=1e-6)

@@ -329,11 +329,19 @@ class TestRemoteTracing:
         # The __trace__ frame-header entry carried every id across: the
         # downstream re-admits under the SAME identities.
         assert down_ids == up_ids and len(up_ids) == 30
-        # Sender-side serde/wire stage spans exist on the sink's track.
-        assert len([e for e in up_events if e[1] == "serde"]) == 30
-        assert len([e for e in up_events if e[1] == "wire"]) == 30
-        # Receiver-side decode cost is measured too.
-        assert len([e for e in down_events if e[1] == "serde"]) == 30
+        # Sender-side serde/wire stage spans exist on the sink's track —
+        # per coalesced FLUSH since the PR-8 record plane, with the
+        # record count attributed on the span (plus the wire.flush span
+        # pricing the coalescing delay separately).
+        up_serde = [e for e in up_events if e[1] == "serde"]
+        up_wire = [e for e in up_events if e[1] == "wire"]
+        assert up_serde and len(up_wire) == len(up_serde)
+        assert sum(e[5]["records"] for e in up_serde) == 30
+        assert [e for e in up_events if e[1] == "wire.flush"]
+        # Receiver-side decode cost is measured too (per frame).
+        down_serde = [e for e in down_events if e[1] == "serde"]
+        assert down_serde
+        assert sum(e[5]["records"] for e in down_serde) == 30
         # The header never leaks into user-visible metadata.
         assert all("__trace__" not in r.meta for r in out)
 
