@@ -171,6 +171,21 @@ def _trace_span_overhead_ns(samples: int = 20000) -> float:
         tracer.span("bench.0", "overhead_probe", 0.0, 1.0)
     return (time.perf_counter() - t0) / samples * 1e9
 
+
+def _flight_record_overhead_ns(samples: int = 20000) -> float:
+    """Micro-measure of one flight-recorder event (clock read + bounded
+    deque append) — the always-on black box's per-event cost, priced
+    next to span_record_ns.  The ISSUE 9 acceptance bound: this must
+    not exceed the tracer's span-record cost (both are one ring
+    append)."""
+    from flink_tensorflow_tpu.tracing import FlightRecorder
+
+    flight = FlightRecorder()
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        flight.record("bench", "overhead_probe")
+    return (time.perf_counter() - t0) / samples * 1e9
+
 # Prose annotations for the machine-readable ceiling-drift code (the
 # code is the source of truth; prose is presentation only).
 CEILING_DRIFT_PROSE = {
@@ -2340,6 +2355,114 @@ def _shuffle_trace_attribution(n, floats, **writer_knobs) -> dict:
     return {"table": table.splitlines(), "rows": attr}
 
 
+#: Peer half (process 1) of the cohort-telemetry bench: the same
+#: rebalance pipeline as the in-bench process 0, run as a REAL separate
+#: process so clock sync, metric pushes and trace stitching cross a
+#: genuine process boundary.
+_COHORT_PEER = r"""
+import sys
+from flink_tensorflow_tpu.utils.platform import force_cpu
+force_cpu(1)
+from flink_tensorflow_tpu import DistributedConfig, StreamExecutionEnvironment
+
+ports, n, throttle, trace, interval = (
+    sys.argv[1], int(sys.argv[2]), float(sys.argv[3]), sys.argv[4],
+    float(sys.argv[5]))
+peers = tuple(f"127.0.0.1:{p}" for p in ports.split(","))
+env = StreamExecutionEnvironment(parallelism=1)
+env.configure(source_throttle_s=throttle, trace=True, trace_path=trace)
+env.set_distributed(DistributedConfig(
+    1, 2, peers, connect_timeout_s=30.0, telemetry_interval_s=interval))
+(env.from_collection(list(range(n)), parallelism=1)
+    .map(lambda x: x + 1, name="work", parallelism=2)
+    .sink_to_callable(lambda v: None, name="sink", parallelism=1))
+env.execute("cohort-bench", timeout=180)
+"""
+
+
+def _shuffle_cohort_telemetry(args) -> dict:
+    """ISSUE 9 pass: a REAL 2-process traced cohort job (process 0 in
+    this process, process 1 a subprocess) prices the telemetry plane —
+    clock-offset quality, metric-push frame bytes, stitching wall time,
+    and the flight recorder's off-path event cost vs the tracer's
+    span-record bound."""
+    import pickle
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    from flink_tensorflow_tpu import (
+        DistributedConfig,
+        StreamExecutionEnvironment,
+    )
+    from flink_tensorflow_tpu.tracing.stitch import (
+        cross_process_traces,
+        merge_cohort_trace_files,
+    )
+
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    n = 400 if args.smoke else 2000
+    throttle = 0.002
+    tmp = tempfile.mkdtemp(prefix="cohort_bench_")
+    trace = os.path.join(tmp, "t.json")
+    env_vars = dict(os.environ)
+    env_vars["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__)),
+         env_vars.get("PYTHONPATH", "")])
+    env_vars.setdefault("JAX_PLATFORMS", "cpu")
+    peer = subprocess.Popen(
+        [sys.executable, "-c", _COHORT_PEER,
+         ",".join(map(str, ports)), str(n), str(throttle), trace, "0.2"],
+        env=env_vars, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    env = StreamExecutionEnvironment(parallelism=1)
+    env.configure(source_throttle_s=throttle, trace=True, trace_path=trace)
+    env.set_distributed(DistributedConfig(
+        0, 2, tuple(f"127.0.0.1:{p}" for p in ports),
+        connect_timeout_s=30.0, telemetry_interval_s=0.2))
+    (env.from_collection(list(range(n)), parallelism=1)
+        .map(lambda x: x + 1, name="work", parallelism=2)
+        .sink_to_callable(lambda v: None, name="sink", parallelism=1))
+    t0 = time.monotonic()
+    handle = env.execute_async("cohort-bench")
+    try:
+        handle.wait(180)
+    finally:
+        out, _ = peer.communicate(timeout=60)
+        assert peer.returncode == 0, out.decode(errors="replace")
+    wall_s = time.monotonic() - t0
+    collector = handle.executor.cohort_collector
+    # One metric push frame as it rides the control channel.
+    push_bytes = len(pickle.dumps(
+        ("metrics_push", 0, 1, env.metric_registry.export_state()),
+        protocol=5))
+    t1 = time.monotonic()
+    merged = merge_cohort_trace_files(
+        [f"{os.path.splitext(trace)[0]}.proc{k}.json" for k in range(2)])
+    stitched = cross_process_traces(merged)
+    merge_wall_s = time.monotonic() - t1
+    return {
+        "records": n,
+        "wall_s": round(wall_s, 3),
+        "collector_pushes": collector.pushes,
+        "peers_reporting": collector.peers_reporting,
+        "collector_push_bytes": push_bytes,
+        "clock_error_bound_us": round(
+            merged["cohort_merge"]["max_error_bound_s"] * 1e6, 1),
+        "merged_events": sum(
+            1 for e in merged["traceEvents"] if e.get("ph") in ("X", "i")),
+        "cross_process_traces": len(stitched),
+        "stitch_wall_s": round(merge_wall_s, 4),
+        "span_record_ns": round(_trace_span_overhead_ns(), 1),
+        "flight_record_ns": round(_flight_record_overhead_ns(), 1),
+    }
+
+
 def bench_shuffle(args) -> dict:
     """Cross-process record-plane microbenchmark (ISSUE 8 acceptance):
     sweeps record sizes over coalescing x columnar x shm arms and
@@ -2398,6 +2521,10 @@ def bench_shuffle(args) -> dict:
         "percord": _shuffle_trace_attribution(trace_n, 1024, flush_bytes=0),
         "coalesced": _shuffle_trace_attribution(trace_n, 1024),
     }
+    # ISSUE 9: with --trace on, also price the cohort telemetry plane
+    # over a REAL 2-process traced job (clock sync + metric pushes +
+    # stitching + the flight recorder's event cost).
+    cohort = _shuffle_cohort_telemetry(args) if _trace_enabled(args) else None
     best_small = max(
         (_mbs("coalesce_columnar_shm", i) or 0) for i in small_idx)
     return {
@@ -2411,6 +2538,7 @@ def bench_shuffle(args) -> dict:
             [round(s, 2) for s in speedups],
         "shm_vs_loopback_tcp_ratio": [round(r, 2) for r in shm_ratios],
         "trace_attribution": trace,
+        "cohort_telemetry": cohort,
         "baseline_note": (
             "percord_tcp IS the pre-coalescing wire (one pickle frame "
             "per record over thread-per-connection TCP semantics); all "
@@ -2655,6 +2783,9 @@ def _scoreboard(outputs: list) -> dict:
         # the end-to-end overhead (tracked like chaining/sanitize).
         sb["trace_overhead"] = {
             "span_record_ns": round(_trace_span_overhead_ns(), 1),
+            # The always-on flight recorder's per-event cost: must stay
+            # within the span-record bound (ISSUE 9 acceptance).
+            "flight_record_ns": round(_flight_record_overhead_ns(), 1),
             "trace_files": len(_TRACE_FILES),
         }
     wire, wire_pre = flag.get("wire") or {}, flag.get("wire_pre") or {}

@@ -669,6 +669,73 @@ def _remote_edge_buffer_timeout(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
+@rule("cohort-telemetry", Severity.WARN)
+def _cohort_telemetry(ctx: AnalysisContext, emit: Emit) -> None:
+    """Distributed observability misconfiguration.  Two findings:
+
+    1. A cohort plan enables tracing or metric reporting but disables
+       the telemetry service (``telemetry_interval_s=0``): no clock
+       sync means cross-process spans stay suppressed and the
+       per-process trace files cannot stitch (``flink-tpu-trace
+       --cohort``), and no metric pushes means ``flink-tpu-inspect
+       --live --cohort`` / the autoscaling-supervisor feed see
+       process 0 ONLY — the per-process reporters keep publishing
+       disjoint files, which reads like cohort coverage but isn't.
+    2. Full-rate tracing (``trace_sample_rate=1.0``) behind an
+       open-loop paced source at high offered rate: every record on
+       every cohort process pays span recording, and the coalesced
+       trace rings rotate too fast to keep the window the post-mortem
+       needs — sample instead (the head-based sampler keeps whole
+       records)."""
+    cfg = ctx.config
+    dist = getattr(cfg, "distributed", None) if cfg is not None else None
+    if cfg is None or dist is None or getattr(dist, "num_processes", 1) < 2:
+        return
+    metrics_cfg = getattr(cfg, "metrics", None)
+    reporting = metrics_cfg is not None and (
+        getattr(metrics_cfg, "report_interval_s", None) is not None
+        or getattr(metrics_cfg, "jsonl_path", None)
+        or getattr(metrics_cfg, "prometheus_path", None)
+        or getattr(metrics_cfg, "http_port", None)
+        or getattr(metrics_cfg, "reporters", ())
+    )
+    observing = bool(getattr(cfg, "trace", False)) or bool(reporting)
+    if observing and getattr(dist, "telemetry_interval_s", 2.0) <= 0:
+        emit(
+            f"distributed plan ({dist.num_processes} processes) enables "
+            "tracing/reporting but telemetry_interval_s=0 disables the "
+            "cohort plane: no clock sync (cross-process spans stay "
+            "suppressed, per-process trace files cannot stitch) and no "
+            "metric pushes (--live --cohort and the supervisor feed see "
+            "process 0 only); set DistributedConfig.telemetry_interval_s "
+            "> 0",
+        )
+    if not getattr(cfg, "trace", False):
+        return
+    if getattr(cfg, "trace_sample_rate", 1.0) < 1.0:
+        return
+    try:
+        from flink_tensorflow_tpu.sources.paced import PacedSplitSource
+    except Exception:  # pragma: no cover - import cycle guard
+        PacedSplitSource = ()  # type: ignore[assignment]
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        source = getattr(op, "source", None)
+        open_loop = isinstance(source, PacedSplitSource) or getattr(
+            source, "is_open_loop", False)
+        rate_hz = getattr(source, "rate_hz", 0.0) or 0.0
+        if open_loop and rate_hz >= 500.0:
+            emit(
+                f"trace_sample_rate=1.0 with an open-loop source offering "
+                f"{rate_hz:g} rec/s per reader across a "
+                f"{dist.num_processes}-process cohort — every record on "
+                "every process pays span recording and the trace rings "
+                "rotate in seconds; lower trace_sample_rate (head-based, "
+                "keeps whole records) for high-rate cohort runs",
+                node=t.name,
+            )
+
+
 @rule("recompile-churn", Severity.WARN)
 def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
     """Shape-signature churn at jit boundaries: several distinct schemas

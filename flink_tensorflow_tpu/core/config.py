@@ -133,6 +133,17 @@ class JobConfig:
     #: source subtask into the trace (deterministic given the metrics
     #: seed — see tracing.Tracer).  1.0 traces everything.
     trace_sample_rate: float = 1.0
+    #: Flight recorder (tracing/flight.py): an always-on bounded ring of
+    #: recent control-rate events (job/subtask lifecycle, barrier
+    #: injections, snapshots, per-report metric deltas) — independent of
+    #: ``trace`` — dumped to ``flight_path`` on crash, sanitizer
+    #: violation, SIGTERM/SIGINT, or ``JobHandle.cancel`` and replayable
+    #: via ``flink-tpu-trace --from-flight-dump``.  False is the
+    #: zero-alloc off path (FLINK_TPU_FLIGHT overrides either way).
+    flight_recorder: bool = True
+    #: Where flight dumps land; None records in memory only (no disk
+    #: write even on crash).  FLINK_TPU_FLIGHT_PATH overrides.
+    flight_path: typing.Optional[str] = None
     #: Device-resident dataflow (tensors/transfer.DeviceBatch): chains
     #: of device-capable operators (model -> model, model -> elementwise
     #: device map) hand HBM-resident batches between fused members — the

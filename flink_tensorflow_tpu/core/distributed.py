@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 import typing
@@ -71,6 +72,11 @@ class DistributedConfig:
     #: stays ``peers[process_index]``).
     bind: str = "0.0.0.0"
     connect_timeout_s: float = 60.0
+    #: Cohort telemetry cadence (core/cohort_telemetry.py): clock-offset
+    #: pings against process 0 and metric-state pushes to its collector
+    #: every this many seconds (a startup burst runs immediately).
+    #: 0 disables the service entirely.
+    telemetry_interval_s: float = 2.0
 
     def validate(self) -> "DistributedConfig":
         if self.num_processes < 1:
@@ -91,6 +97,8 @@ class DistributedConfig:
                 raise ValueError(f"peer {peer!r} is not 'host:port'")
         if self.connect_timeout_s <= 0:
             raise ValueError("connect_timeout_s must be > 0")
+        if self.telemetry_interval_s < 0:
+            raise ValueError("telemetry_interval_s must be >= 0")
         return self
 
     def endpoint(self, process_index: int) -> typing.Tuple[str, int]:
@@ -156,9 +164,12 @@ class DistributedExecutor(LocalExecutor):
         #: reported their shard durable.
         self._durable_acks: typing.Dict[int, typing.Set[int]] = {}
         self._durable_cv = threading.Condition()
-        #: Control channels to peers (lazy; used only by the single
-        #: persist worker thread).
+        #: Control channels to peers (lazy; shared by the persist
+        #: worker's commit gate and the telemetry service thread —
+        #: creation is serialized by the lock, writes by each writer's
+        #: own RLock).
         self._control_writers: typing.Dict[int, RemoteChannelWriter] = {}
+        self._control_writers_lock = threading.Lock()
         #: Set once a durability announce reached EVERY peer — only then
         #: is the gate's fast-fail connect cap safe (ADVICE r4: the
         #: first checkpoint can race a peer's cold-compile-before-serve
@@ -199,7 +210,45 @@ class DistributedExecutor(LocalExecutor):
         for st in self.subtasks:
             if st.gate is not None:
                 self._server.register_gate(st.t.name, st.index, st.gate)
+        # -- cohort telemetry plane --------------------------------------
+        # Per-process trace files: a cohort exporting to ONE path would
+        # clobber itself on a shared filesystem, and `flink-tpu-trace
+        # --cohort` needs the per-process files to stitch.
+        if self.trace_path and self.dist.num_processes > 1:
+            root, ext = os.path.splitext(self.trace_path)
+            self.trace_path = (
+                f"{root}.proc{self.dist.process_index}{ext or '.json'}")
+        if self.tracer is not None:
+            # Exported even before (or without) clock sync: the merge
+            # then treats this process as offset-0, which is exact for
+            # process 0 and loudly approximate for peers.
+            self.tracer.cohort_meta = {
+                "process_index": self.dist.process_index,
+                "pid": os.getpid(),
+                "offset_to_proc0_s": 0.0,
+                "error_bound_s": float(
+                    "inf") if self.dist.process_index else 0.0,
+            }
+        from flink_tensorflow_tpu.core.cohort_telemetry import (
+            CohortTelemetryService,
+        )
+
+        self._telemetry = CohortTelemetryService(
+            process_index=self.dist.process_index,
+            num_processes=self.dist.num_processes,
+            pid=os.getpid(),
+            send=self._send_control,
+            registry=self.metrics,
+            tracer=self.tracer,
+            flight=self.flight,
+            interval_s=self.dist.telemetry_interval_s,
+        )
+        #: The cohort-wide merged metric feed (process 0 only; None on
+        #: peers): `flink-tpu-inspect --live --cohort` and the ROADMAP's
+        #: autoscaling supervisor poll `cohort_collector.merged_snapshot()`.
+        self.cohort_collector = self._telemetry.collector
         self._server.start()
+        self._telemetry.start()
 
     # -- placement ------------------------------------------------------
     def _owns_subtask(self, t: Transformation, index: int) -> bool:
@@ -229,15 +278,51 @@ class DistributedExecutor(LocalExecutor):
         self._remote_writers.append(writer)
         return writer
 
-    # -- global 2PC commit point -----------------------------------------
+    # -- control plane ---------------------------------------------------
     def _on_control(self, sender: int, message: typing.Any) -> None:
         kind, cid = message[0], message[1]
-        if kind != "ckpt_durable":
-            logger.warning("unknown control message %r from %d", kind, sender)
+        if kind == "ckpt_durable":
+            with self._durable_cv:
+                self._durable_acks.setdefault(cid, set()).add(sender)
+                self._durable_cv.notify_all()
             return
-        with self._durable_cv:
-            self._durable_acks.setdefault(cid, set()).add(sender)
-            self._durable_cv.notify_all()
+        # Telemetry frames (clock sync, metric pushes): enqueue onto the
+        # service's own thread — this callback runs ON the reactor, and
+        # a blocking send from here would stall the record plane.
+        if self._telemetry is not None and self._telemetry.handles(kind):
+            self._telemetry.on_control(sender, message)
+            return
+        logger.warning("unknown control message %r from %d", kind, sender)
+
+    def _get_control_writer(self, peer: int,
+                            timeout_s: typing.Optional[float] = None
+                            ) -> RemoteChannelWriter:
+        """The (lazily created, process-shared) control writer to
+        ``peer``.  Creation is serialized; the writer itself is
+        thread-safe, so the commit gate and the telemetry service can
+        share one connection per peer."""
+        with self._control_writers_lock:
+            writer = self._control_writers.get(peer)
+            if writer is None:
+                host, port = self.dist.endpoint(peer)
+                writer = RemoteChannelWriter(
+                    host, port, ShuffleServer.CONTROL_TASK,
+                    self.dist.process_index, 0,
+                    connect_timeout_s=(
+                        self.dist.connect_timeout_s if timeout_s is None
+                        else timeout_s),
+                )
+                self._control_writers[peer] = writer
+            return writer
+
+    def _send_control(self, peer: int, message: typing.Any) -> None:
+        """Telemetry-service send hook (its own thread, never the
+        reactor's)."""
+        if self.cancelled.is_set():
+            return
+        self._get_control_writer(peer).write(message)
+
+    # -- global 2PC commit point -----------------------------------------
 
     def _global_commit_gate(self, checkpoint_id: int) -> bool:
         """Called by the coordinator after the LOCAL shard of
@@ -261,27 +346,20 @@ class DistributedExecutor(LocalExecutor):
             # (ADVICE r3 low: teardown stalling the persist thread).
             if self.cancelled.is_set():
                 return False
-            writer = self._control_writers.get(p)
-            if writer is None:
-                host, port = self.dist.endpoint(p)
-                # Short connect window once the cohort is proven up (a
-                # prior announce reached every peer): from then on only a
-                # DYING peer is unreachable here, and the gate should
-                # fail fast, not wait out the cohort-startup grace
-                # period.  The FIRST gate keeps the full configured
-                # window — it can legitimately race a peer's cold XLA
-                # compile before its shuffle server answers (ADVICE r4:
-                # the unconditional 5s cap failed that gate spuriously
-                # and delayed the first 2PC commit by a checkpoint).
-                timeout_s = (
-                    min(5.0, self.dist.connect_timeout_s)
-                    if self._gate_warmed else self.dist.connect_timeout_s
-                )
-                writer = RemoteChannelWriter(
-                    host, port, ShuffleServer.CONTROL_TASK, me, 0,
-                    connect_timeout_s=timeout_s,
-                )
-                self._control_writers[p] = writer
+            # Short connect window once the cohort is proven up (a
+            # prior announce reached every peer): from then on only a
+            # DYING peer is unreachable here, and the gate should
+            # fail fast, not wait out the cohort-startup grace
+            # period.  The FIRST gate keeps the full configured
+            # window — it can legitimately race a peer's cold XLA
+            # compile before its shuffle server answers (ADVICE r4:
+            # the unconditional 5s cap failed that gate spuriously
+            # and delayed the first 2PC commit by a checkpoint).
+            timeout_s = (
+                min(5.0, self.dist.connect_timeout_s)
+                if self._gate_warmed else self.dist.connect_timeout_s
+            )
+            writer = self._get_control_writer(p, timeout_s)
             try:
                 writer.write(announcement)
             except (OSError, TimeoutError):
@@ -335,6 +413,9 @@ class DistributedExecutor(LocalExecutor):
 
     def cancel(self) -> None:
         super().cancel()
+        telemetry = getattr(self, "_telemetry", None)
+        if telemetry is not None:
+            telemetry.stop()
         # Unblock writers stuck in sendall, readers stuck in recv, and
         # the persist thread waiting on the global commit gate.
         # join=False: cancel may run on a shuffle reader thread (via
@@ -353,6 +434,9 @@ class DistributedExecutor(LocalExecutor):
         try:
             super().join(timeout)
         finally:
+            telemetry = getattr(self, "_telemetry", None)
+            if telemetry is not None:
+                telemetry.stop()
             for w in list(self._remote_writers):
                 w.close()
             for w in list(self._control_writers.values()):

@@ -51,6 +51,10 @@ class JobHandle:
         #: metrics.reporters.ReporterThread when the job runs with a
         #: report interval; None otherwise (no thread ever started).
         self.reporter = reporter
+        #: tracing.flight.ShutdownFlusher installed by execute_async so
+        #: SIGTERM/SIGINT flush the reporter + flight recorder + trace
+        #: before the process dies; uninstalled at wait()/cancel().
+        self._flusher = None
 
     def trigger_checkpoint(self, timeout: typing.Optional[float] = None):
         """Run one aligned checkpoint; returns the snapshot mapping.
@@ -66,6 +70,8 @@ class JobHandle:
             # Stop on failure too: the final report + sink close land
             # before the exception surfaces (last observations are often
             # exactly what the failure post-mortem needs).
+            if self._flusher is not None:
+                self._flusher.uninstall()
             if self.reporter is not None:
                 self.reporter.stop()
             self._export_trace()
@@ -93,8 +99,12 @@ class JobHandle:
         # writer; they are valid restore points, so cancel must not
         # abandon them (a caller typically restores right after).
         self.executor.coordinator.wait_for_persistence(60.0)
+        if self._flusher is not None:
+            self._flusher.uninstall()
         if self.reporter is not None:
             self.reporter.stop()
+        # A cancelled worker keeps its black box, same as a killed one.
+        self.executor.flight_dump("cancel")
         self._export_trace()
 
     @property
@@ -342,6 +352,8 @@ class StreamExecutionEnvironment:
             trace=cfg.trace,
             trace_path=cfg.trace_path,
             trace_sample_rate=cfg.trace_sample_rate,
+            flight_recorder=cfg.flight_recorder,
+            flight_path=cfg.flight_path,
         )
         if cfg.distributed is not None:
             from flink_tensorflow_tpu.core.distributed import DistributedExecutor
@@ -451,7 +463,8 @@ class StreamExecutionEnvironment:
         if validate:
             self.validate_plan()
         executor = self._make_executor()
-        reporter = self._make_reporter(report_interval_s)
+        reporter = self._make_reporter(report_interval_s,
+                                       flight=executor.flight)
         executor.checkpoint_interval_s = self.checkpoint_interval_s
         if restore_from is not None:
             from flink_tensorflow_tpu.checkpoint.store import read_checkpoint
@@ -520,14 +533,36 @@ class StreamExecutionEnvironment:
         executor.start()
         if reporter is not None:
             reporter.start()
-        return JobHandle(executor, reporter)
+        handle = JobHandle(executor, reporter)
+        # Graceful-shutdown flush: SIGTERM/SIGINT publish the final
+        # reporter snapshot, dump the flight ring, and export the trace
+        # BEFORE the previous handler (usually: death) runs — a killed
+        # worker no longer loses its last reporting interval.  Chained
+        # and uninstalled at wait()/cancel(); no-op off the main thread.
+        from flink_tensorflow_tpu.tracing.flight import ShutdownFlusher
 
-    def _make_reporter(self, report_interval_s: typing.Optional[float]):
+        callbacks = []
+        if reporter is not None:
+            callbacks.append(reporter.flush_now)
+        if executor.flight is not None and executor.flight_path:
+            callbacks.append(lambda: executor.flight_dump("signal"))
+        if executor.tracer is not None and executor.trace_path:
+            callbacks.append(handle._export_trace)
+        if callbacks:
+            flusher = ShutdownFlusher(callbacks)
+            if flusher.install():
+                handle._flusher = flusher
+        return handle
+
+    def _make_reporter(self, report_interval_s: typing.Optional[float],
+                       flight=None):
         """Build (without starting) the job's ReporterThread, or None.
 
         The interval resolves call-site argument first, then
         ``config.metrics.report_interval_s``.  No interval -> no thread,
         no sink construction — the documented zero-overhead default.
+        ``flight`` (the executor's FlightRecorder) receives compact
+        metric-delta events each report.
         """
         cfg = self.config.metrics
         interval = (report_interval_s if report_interval_s is not None
@@ -542,4 +577,5 @@ class StreamExecutionEnvironment:
         sinks = cfg.build_reporters()
         if not sinks:
             sinks = [ConsoleReporter()]
-        return ReporterThread(self.metric_registry, sinks, interval)
+        return ReporterThread(self.metric_registry, sinks, interval,
+                              flight=flight)

@@ -386,6 +386,16 @@ class Connection:
                 return  # kernel buffer full; wait for the next EVENT_WRITE
         self._update_interest()
 
+    @property
+    def send_queue_depth(self) -> int:
+        """Frames parked on the writer-side queue (reactor gauge)."""
+        return len(self._out)
+
+    @property
+    def send_queue_bytes(self) -> int:
+        """Bytes pending on the writer-side queue (reactor gauge)."""
+        return self._out_bytes
+
     def drain(self, timeout: typing.Optional[float] = None) -> bool:
         """Wait for the send queue to empty; True when drained."""
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -463,6 +473,15 @@ class Reactor:
         #: consumer poll), NOT a general timer — keep intervals >= 1 ms.
         self._pollers: typing.Dict[typing.Callable[[], None],
                                    typing.List[float]] = {}
+        #: Event-loop lag observability (plain float stores on the loop
+        #: thread — no locks, no metric objects; readers are pull-based
+        #: gauges registered by ShuffleServer): how long the last
+        #: select() wakeup spent dispatching its events + tasks, and the
+        #: worst case seen.  A loop stuck behind one slow handler shows
+        #: up here before it shows up as cohort-wide backpressure.
+        self.poll_to_dispatch_s = 0.0
+        self.max_poll_to_dispatch_s = 0.0
+        self.dispatches = 0
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._started = False
 
@@ -543,6 +562,7 @@ class Reactor:
                 events = self._sel.select(timeout=self._poll_timeout())
             except OSError:
                 return  # selector closed under us (close())
+            t_ready = time.monotonic()
             self._run_due_pollers()
             for key, mask in events:
                 if key.data is None:  # wake pipe
@@ -564,6 +584,15 @@ class Reactor:
                     fn()
                 except BaseException:  # noqa: BLE001
                     logger.exception("reactor task failed")
+            if events:
+                # Poll-to-dispatch lag: socket-ready -> all handlers and
+                # queued tasks served.  Every connection on the loop
+                # waits at least this long behind its peers' handlers.
+                lag = time.monotonic() - t_ready
+                self.poll_to_dispatch_s = lag
+                if lag > self.max_poll_to_dispatch_s:
+                    self.max_poll_to_dispatch_s = lag
+                self.dispatches += 1
 
     def close(self, join: bool = True) -> None:
         self._stop.set()

@@ -295,6 +295,10 @@ class _Subtask:
         if tracer is not None:
             tracer.span(unit.scope, "snapshot", t0, time.monotonic(),
                         args={"checkpoint": checkpoint_id})
+        flight = self.executor.flight
+        if flight is not None:
+            flight.record(unit.scope, "snapshot",
+                          {"checkpoint": checkpoint_id})
 
     # --- thread bodies ---------------------------------------------------
     def _source_barrier(self, checkpoint_id: int) -> None:
@@ -304,6 +308,10 @@ class _Subtask:
         if tracer is not None:
             tracer.instant(self.scope, "barrier.inject",
                            args={"checkpoint": checkpoint_id})
+        flight = self.executor.flight
+        if flight is not None:
+            flight.record(self.scope, "barrier.inject",
+                          {"checkpoint": checkpoint_id})
         self._snapshot_and_ack(checkpoint_id)
         self.output.broadcast_element(el.CheckpointBarrier(checkpoint_id))
 
@@ -378,6 +386,10 @@ class _Subtask:
         if tracer is not None:
             tracer.instant(self.scope, "barrier.inject",
                            args={"checkpoint": checkpoint_id})
+        flight = self.executor.flight
+        if flight is not None:
+            flight.record(self.scope, "barrier.inject",
+                          {"checkpoint": checkpoint_id})
         op = typing.cast("typing.Any", self.operator)
         op.on_barrier(checkpoint_id)
         self._snapshot_and_ack(checkpoint_id)
@@ -638,6 +650,8 @@ class LocalExecutor:
         trace: bool = False,
         trace_path: typing.Optional[str] = None,
         trace_sample_rate: float = 1.0,
+        flight_recorder: bool = True,
+        flight_path: typing.Optional[str] = None,
         device_resident: bool = False,
         wire_dtype: typing.Optional[str] = None,
         wire_flush_bytes: typing.Optional[int] = None,
@@ -705,6 +719,19 @@ class LocalExecutor:
         #: when the job finishes OR fails (the crash trace is the one
         #: that matters).  None keeps spans in memory (CLI path).
         self.trace_path = trace_path or tracing.env_trace_path()
+        #: Flight recorder (tracing/flight.py): the always-on black box —
+        #: a bounded ring of control-rate lifecycle/checkpoint/metric-
+        #: delta events, dumped to ``flight_path`` on crash, sanitizer
+        #: violation, signal, or cancel.  ``flight_recorder=False`` /
+        #: FLINK_TPU_FLIGHT=0 is the zero-alloc off path (tier-1
+        #: guarded); the ring runs regardless of whether a dump path is
+        #: configured.
+        from flink_tensorflow_tpu.tracing import flight as flight_mod
+
+        env_flight = flight_mod.env_enabled()
+        flight_on = flight_recorder if env_flight is None else env_flight
+        self.flight = flight_mod.FlightRecorder() if flight_on else None
+        self.flight_path = flight_path or flight_mod.env_flight_path()
         if self.sanitizer is not None and self.tracer is not None:
             # Satellite wiring: sanitizer findings (stall dumps with
             # thread stacks + lock ownership, protocol violations) land
@@ -1150,6 +1177,11 @@ class LocalExecutor:
 
     # --- execution --------------------------------------------------------
     def start(self) -> None:
+        if self.flight is not None:
+            self.flight.record("job", "start", {
+                "subtasks": len(self.subtasks),
+                "logical_subtasks": self.total_subtasks,
+            })
         for st in self.subtasks:
             if not st.t.is_source:
                 body = st.run_worker
@@ -1230,13 +1262,28 @@ class LocalExecutor:
             # failed job (SanitizerError is NOT a JobFailure: restart
             # strategies must not replay over a concurrency bug).
             self.sanitizer.shutdown()
-            self.sanitizer.check()
+            try:
+                self.sanitizer.check()
+            except BaseException:
+                if self.flight is not None:
+                    self.flight.record("job", "sanitizer.violation", {
+                        "violations": len(self.sanitizer.violations)})
+                    self.flight_dump("sanitizer")
+                raise
 
     def run(self, timeout: typing.Optional[float] = None) -> None:
         self.start()
         self.join(timeout)
 
     # --- failure / teardown ----------------------------------------------
+    def flight_dump(self, reason: str) -> typing.Optional[str]:
+        """Dump the flight ring to the configured path (no-op without a
+        recorder or a path); returns the written path."""
+        if self.flight is None or not self.flight_path:
+            return None
+        return self.flight.dump(self.flight_path, reason,
+                                tracer=self.tracer)
+
     def fail(self, subtask: _Subtask, exc: BaseException) -> None:
         with self._error_lock:
             first = self._error is None
@@ -1249,6 +1296,12 @@ class LocalExecutor:
                 self.tracer.instant(
                     "job", "failure",
                     args={"subtask": subtask.scope, "error": repr(exc)})
+            if self.flight is not None:
+                # The black box lands BEFORE any teardown runs further:
+                # the ring holds the lifecycle that led here.
+                self.flight.record("job", "failure", {
+                    "subtask": subtask.scope, "error": repr(exc)})
+                self.flight_dump("crash")
             # Crash-time observability: flush the reporter (and any other
             # registered listener) NOW, while the gauges still show the
             # state that produced the failure — the final stop() flush
@@ -1280,6 +1333,8 @@ class LocalExecutor:
             st.add_notification(checkpoint_id)
 
     def subtask_finished(self, subtask: _Subtask) -> None:
+        if self.flight is not None:
+            self.flight.record(subtask.scope, "subtask.finished")
         self.coordinator.subtask_finished(subtask)
         with self._error_lock:
             self._finished_count += 1

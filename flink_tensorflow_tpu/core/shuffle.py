@@ -321,6 +321,7 @@ class _ServerRoute:
         self.eof_clean: typing.Optional[bool] = None  # None = conn still open
         self.done = False
         self._records = self._bytes = None
+        self._gate_paused = None
         self.conn = Connection(
             server.reactor, sock,
             parser=ShuffleFrameParser(),
@@ -382,6 +383,10 @@ class _ServerRoute:
                 f"shuffle.in.{self.task}.{self.subtask_index}.ch{self.channel_idx}")
             self._records = group.counter("records")
             self._bytes = group.counter("bytes")
+            # Backpressure visibility: each full-gate stall of this
+            # connection (delivery paused, kernel TCP window closing on
+            # the peer) ticks once.
+            self._gate_paused = group.counter("gate_paused")
         return True
 
     def _ingest(self, obj, nbytes: int) -> None:
@@ -408,6 +413,8 @@ class _ServerRoute:
                     if type(element) is el.EndOfPartition:
                         self.saw_eop = True
                 if taken < len(batch):
+                    if self._gate_paused is not None:
+                        self._gate_paused.inc()
                     return False
             if self.ring is None:
                 return True
@@ -556,6 +563,20 @@ class ShuffleServer:
     def start(self) -> None:
         self.reactor.start()
         self.reactor.add_acceptor(self._listener, self._on_accept)
+        if self.metrics is not None:
+            # Event-loop observability: pull-based gauges over the
+            # reactor's plain-float lag stores (the loop thread is the
+            # single writer; readers are the reporter/inspector/cohort
+            # push).  One slow handler shows up here before it shows up
+            # as cohort-wide backpressure.
+            group = self.metrics.group("reactor")
+            reactor = self.reactor
+            group.gauge("poll_to_dispatch_s",
+                        lambda: reactor.poll_to_dispatch_s)
+            group.gauge("max_poll_to_dispatch_s",
+                        lambda: reactor.max_poll_to_dispatch_s)
+            group.gauge("dispatches", lambda: reactor.dispatches)
+            group.gauge("connections", lambda: len(self._routes))
 
     def _on_accept(self, conn: socket.socket) -> None:
         if self._stop.is_set():
@@ -706,6 +727,16 @@ class RemoteChannelWriter:
             # Job-wide flush meter (Meter is thread-safe): one rate for
             # the whole plane, reasons attributed per edge above.
             self._flush_total = metrics.group("wire").meter("flush_total")
+            # Reactor-mode writers park frames on a bounded send queue;
+            # depth / bytes-pending show WHICH edge a slow peer or a
+            # stalled loop is backing up (0 for blocking/standalone
+            # writers and before the lazy connect).
+            group.gauge("send_queue_depth",
+                        lambda: (0 if self._conn is None
+                                 else self._conn.send_queue_depth))
+            group.gauge("send_queue_bytes",
+                        lambda: (0 if self._conn is None
+                                 else self._conn.send_queue_bytes))
 
     # -- connection ------------------------------------------------------
     def _connect(self) -> None:

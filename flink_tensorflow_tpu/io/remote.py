@@ -42,6 +42,7 @@ path (one vectorized cast per field per frame).
 from __future__ import annotations
 
 import collections
+import os
 import selectors
 import socket
 import struct
@@ -61,6 +62,10 @@ from flink_tensorflow_tpu.tensors.serde import (
 from flink_tensorflow_tpu.tensors.value import TensorValue
 
 _LEN = struct.Struct("<Q")
+
+#: Cached origin pid for cross-process trace stamps (matches the
+#: tracer's own _PID — same process).
+_PID = os.getpid()
 
 
 class RemoteSink(fn.SinkFunction):
@@ -178,10 +183,14 @@ class RemoteSink(fn.SinkFunction):
             # The record's trace id rides the frame (TensorValue metadata
             # encodes with the record), so the receiving RemoteSource
             # re-admits it under the SAME trace — one logical record, one
-            # trace, across the job boundary.
+            # trace, across the job boundary.  The origin pid + send
+            # stamp let a clock-synced receiver record the remote hop as
+            # an offset-corrected queue span (Tracer.admit); an unsynced
+            # receiver keeps only the id, as before.
             tctx = tracer.current()
             if tctx is not None:
-                value = value.with_meta(__trace__=tctx.trace_id)
+                value = value.with_meta(
+                    __trace__=(tctx.trace_id, _PID, time.monotonic()))
         with self._lock:
             if self._error is not None:
                 exc, self._error = self._error, None

@@ -8,9 +8,19 @@
 - :mod:`.inspector` — ``python -m flink_tensorflow_tpu.metrics
   <pipeline.py>`` / ``flink-tpu-inspect``: execute a pipeline under the
   metric plane and print per-operator rate, latency percentiles, queue
-  depth, backpressure, and watermark lag.
+  depth, backpressure, and watermark lag (``--live --cohort``: rows
+  aggregated over a whole DistributedExecutor cohort).
+- :mod:`.cohort` — distributed metric aggregation: per-process state
+  trees merge on the process-0 :class:`CohortCollector` (meters sum,
+  reservoirs merge, gauges per policy) — the cohort-wide inspector view
+  and the autoscaling supervisor's programmatic feed.
 """
 
+from flink_tensorflow_tpu.metrics.cohort import (
+    CohortCollector,
+    merge_states,
+    state_to_snapshot,
+)
 from flink_tensorflow_tpu.metrics.registry import (
     Counter,
     Gauge,
@@ -31,6 +41,7 @@ from flink_tensorflow_tpu.metrics.reporters import (
 )
 
 __all__ = [
+    "CohortCollector",
     "ConsoleReporter",
     "Counter",
     "Gauge",
@@ -45,4 +56,6 @@ __all__ = [
     "PrometheusFileReporter",
     "ReporterThread",
     "Timer",
+    "merge_states",
+    "state_to_snapshot",
 ]

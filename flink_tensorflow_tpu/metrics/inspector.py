@@ -225,14 +225,20 @@ def live_inspect(
     stream: typing.Optional[typing.TextIO] = None,
     max_frames: typing.Optional[int] = None,
     timeout_s: float = 600.0,
+    cohort: bool = False,
 ) -> typing.Dict[str, typing.Any]:
     """``flink-tpu-inspect --live``: run the pipeline with a reporter
     thread attached and render a top-style per-operator frame each
     interval, polling the reporter stream (a
     :class:`~flink_tensorflow_tpu.metrics.reporters.
     LatestSnapshotReporter` sink) — the first in-repo consumer of the
-    runtime gauges.  Returns the final job snapshot (same shape as
-    :func:`inspect_pipeline`)."""
+    runtime gauges.  With ``cohort=True`` (``--live --cohort``) the
+    frames poll the process-0 :class:`~flink_tensorflow_tpu.metrics.
+    cohort.CohortCollector` instead — per-operator rows AGGREGATED over
+    every cohort process (the same merged snapshot the autoscaling
+    supervisor consumes); requires the pipeline to configure
+    ``distributed=`` with ``process_index=0``.  Returns the final job
+    snapshot (same shape as :func:`inspect_pipeline`)."""
     from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
     from flink_tensorflow_tpu.metrics.reporters import LatestSnapshotReporter
 
@@ -246,19 +252,40 @@ def live_inspect(
     ))
     t0 = time.monotonic()
     handle = env.execute_async("inspect-live")
+    collector = None
+    if cohort:
+        collector = getattr(handle.executor, "cohort_collector", None)
+        if collector is None:
+            handle.executor.cancel()
+            handle.wait(timeout=timeout_s)
+            raise ValueError(
+                "--cohort needs the process-0 member of a distributed "
+                "job: configure JobConfig(distributed=DistributedConfig("
+                "process_index=0, ...)) in the pipeline (peers run the "
+                "same script with their own process_index and push to "
+                "this collector)")
     done = handle.executor._all_done
     frames = 0
     clear = "\x1b[2J\x1b[H" if getattr(out, "isatty", lambda: False)() else ""
     try:
         while True:
             finished = done.wait(interval_s)
-            report = latest.latest()
+            if collector is not None:
+                report = collector.merged_snapshot()
+            else:
+                report = latest.latest()
             if report is not None:
                 ts, snapshot = report
                 stamp = time.strftime("%H:%M:%S", time.localtime(ts))
                 frames += 1
+                scope_note = ""
+                if collector is not None:
+                    reporting = 1 + len(collector.peers_reporting)
+                    scope_note = (f", cohort {reporting}/"
+                                  f"{collector.num_processes} procs")
                 print(f"{clear}== {path} [live {stamp}, frame {frames}, "
-                      f"{time.monotonic() - t0:.1f}s] ==", file=out)
+                      f"{time.monotonic() - t0:.1f}s{scope_note}] ==",
+                      file=out)
                 print(format_live_table(build_live_rows(snapshot)), file=out)
                 out.flush()
             if finished or (max_frames is not None and frames >= max_frames):
@@ -269,14 +296,24 @@ def live_inspect(
         handle.executor.cancel()
         handle.wait(timeout=timeout_s)
     wall_s = time.monotonic() - t0
-    tree = env.metric_registry.snapshot()
-    return {
+    if collector is not None:
+        tree = collector.merged_snapshot()[1]
+    else:
+        tree = env.metric_registry.snapshot()
+    result = {
         "pipeline": path,
         "wall_s": wall_s,
         "frames": frames,
         "subtasks": build_rows(tree, wall_s),
         "job": {scope: tree[scope] for scope in _JOB_SCOPES if scope in tree},
     }
+    if collector is not None:
+        result["cohort"] = {
+            "num_processes": collector.num_processes,
+            "peers_reporting": collector.peers_reporting,
+            "pushes": collector.pushes,
+        }
+    return result
 
 
 def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
@@ -310,7 +347,16 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                              "runs, polling the reporter stream")
     parser.add_argument("--live-interval", type=float, default=1.0,
                         help="live-view frame period in seconds (default 1.0)")
+    parser.add_argument("--cohort", action="store_true",
+                        help="with --live on the process-0 member of a "
+                             "distributed job: render rows aggregated over "
+                             "the WHOLE cohort (the CohortCollector's merged "
+                             "snapshot — meters summed, reservoirs merged, "
+                             "gauges per policy) instead of this process "
+                             "alone")
     args = parser.parse_args(argv)
+    if args.cohort and not args.live:
+        parser.error("--cohort requires --live")
 
     exit_code = 0
     for path in args.pipelines:
@@ -320,6 +366,7 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                     path, args.job_args.split(),
                     interval_s=args.live_interval,
                     timeout_s=args.timeout,
+                    cohort=args.cohort,
                 )
             else:
                 snap = inspect_pipeline(

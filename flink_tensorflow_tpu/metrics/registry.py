@@ -188,6 +188,17 @@ class Timer:
         return out
 
 
+def _strided(samples: typing.Sequence[float], cap: int) -> typing.List[float]:
+    """Deterministic down-sample: every k-th reservoir entry, bounded by
+    ``cap`` — no RNG, so two exports of the same state are identical
+    (the cohort merge's determinism contract)."""
+    n = len(samples)
+    if n <= cap:
+        return [float(s) for s in samples]
+    stride = (n + cap - 1) // cap
+    return [float(samples[i]) for i in range(0, n, stride)]
+
+
 class MetricGroup:
     """Namespaced metric container for one operator subtask."""
 
@@ -289,6 +300,40 @@ class MetricRegistry:
         tree: typing.Dict[str, typing.Dict[str, typing.Any]] = {}
         for (scope, name), metric in self.all_metrics().items():
             tree.setdefault(scope, {})[name] = self._read(metric)
+        return tree
+
+    def export_state(self, max_samples: int = 512) -> typing.Dict[str, typing.Dict[str, tuple]]:
+        """Transferable per-metric STATE tree ``{scope: {name: (kind,
+        payload)}}`` — what a cohort process pushes to the process-0
+        collector (metrics/cohort.py).  Unlike :meth:`snapshot` this
+        keeps histogram/timer RESERVOIR SAMPLES (strided down to
+        ``max_samples`` so a push frame stays small) so the collector
+        can merge distributions instead of averaging percentiles, and
+        evaluates gauges to plain values so the receiving side applies
+        an aggregation policy per name."""
+        tree: typing.Dict[str, typing.Dict[str, tuple]] = {}
+        for (scope, name), metric in self.all_metrics().items():
+            if isinstance(metric, Counter):
+                entry = ("counter", metric.value)
+            elif isinstance(metric, Meter):
+                entry = ("meter", {"count": metric.count,
+                                   "rate": metric.rate(),
+                                   "window_rate": metric.window_rate()})
+            elif isinstance(metric, Timer):
+                entry = ("timer", {
+                    "count": metric.count, "total_s": metric.total_s,
+                    "samples": _strided(metric.histogram._samples, max_samples),
+                })
+            elif isinstance(metric, Histogram):
+                entry = ("histogram", {
+                    "count": metric.count,
+                    "samples": _strided(metric._samples, max_samples),
+                })
+            elif isinstance(metric, Gauge):
+                entry = ("gauge", metric.value())
+            else:
+                entry = ("value", metric)
+            tree.setdefault(scope, {})[name] = entry
         return tree
 
     def reset_windows(self) -> None:

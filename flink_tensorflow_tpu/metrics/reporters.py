@@ -311,12 +311,17 @@ class ReporterThread:
 
     def __init__(self, registry: MetricRegistry,
                  reporters: typing.Sequence[MetricReporter],
-                 interval_s: float):
+                 interval_s: float, *, flight=None):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be > 0, got {interval_s}")
         self.registry = registry
         self.reporters = list(reporters)
         self.interval_s = interval_s
+        #: Optional tracing.flight.FlightRecorder: each report also
+        #: lands a compact per-scope metric-delta event in the black
+        #: box, so a crash dump shows the record-flow history even on
+        #: untraced jobs.
+        self.flight = flight
         self._stop = threading.Event()
         self._thread: typing.Optional[threading.Thread] = None
 
@@ -340,6 +345,11 @@ class ReporterThread:
                     "metric reporter %s failed", type(reporter).__name__,
                     exc_info=True,
                 )
+        if self.flight is not None:
+            try:
+                self.flight.metric_delta(snapshot)
+            except Exception:  # noqa: BLE001 - observability only
+                pass
         # Window rates mean "since the previous report" — the reporter
         # thread owns the window cadence (window_rate() itself is pure).
         self.registry.reset_windows()

@@ -15,22 +15,40 @@ from flink_tensorflow_tpu.tracing.attribution import (
     events_from_chrome,
     format_attribution_table,
 )
+from flink_tensorflow_tpu.tracing.clocksync import OffsetEstimator
+from flink_tensorflow_tpu.tracing.flight import (
+    FlightRecorder,
+    load_flight_dump,
+)
+from flink_tensorflow_tpu.tracing.stitch import (
+    cross_process_traces,
+    merge_cohort_trace_files,
+    merge_cohort_traces,
+)
 from flink_tensorflow_tpu.tracing.tracer import (
     TraceContext,
     Tracer,
     env_enabled,
     env_sample_rate,
     env_trace_path,
+    events_to_chrome,
 )
 
 __all__ = [
     "STAGES",
+    "FlightRecorder",
+    "OffsetEstimator",
     "TraceContext",
     "Tracer",
     "attribution",
+    "cross_process_traces",
     "env_enabled",
     "env_sample_rate",
     "env_trace_path",
     "events_from_chrome",
+    "events_to_chrome",
     "format_attribution_table",
+    "load_flight_dump",
+    "merge_cohort_trace_files",
+    "merge_cohort_traces",
 ]

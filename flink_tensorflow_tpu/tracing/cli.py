@@ -4,13 +4,19 @@ per-operator latency-attribution table.
     python -m flink_tensorflow_tpu.tracing examples/mnist_lenet.py
     flink-tpu-trace examples/mnist_lenet.py --out lenet.trace.json
     flink-tpu-trace --from-file lenet.trace.json   # re-attribute a capture
+    flink-tpu-trace --cohort t.proc0.json t.proc1.json --out merged.json
+    flink-tpu-trace --from-flight-dump flight.json  # replay a crash ring
 
 Captures the pipeline's plan the same way the analyzer/inspector CLIs do
 (``analysis.capture``), executes it with ``trace=True``, writes the
 Chrome trace JSON (Perfetto-loadable), and prints p50/p95/p99 per stage
 (queue / h2d / compute / d2h / serde / wire) per operator plus one
-machine-readable JSON line.  Exit 0 = ran to completion; 2 = capture or
-execution failed.
+machine-readable JSON line.  ``--cohort`` instead MERGES a distributed
+job's per-process trace files onto the process-0 clock (tracing/
+stitch.py) — one Perfetto timeline with per-process track groups and
+offset-corrected cross-process spans.  ``--from-flight-dump`` replays a
+flight-recorder crash dump through the same table/export.  Exit 0 = ran
+to completion; 2 = capture or execution failed.
 """
 
 from __future__ import annotations
@@ -70,6 +76,18 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     parser.add_argument("--from-file", default=None, metavar="TRACE.json",
                         help="skip execution: attribute an existing exported "
                              "Chrome trace instead")
+    parser.add_argument("--cohort", action="store_true",
+                        help="treat the positional arguments as a cohort's "
+                             "per-process trace files (*.proc<k>.json): merge "
+                             "them onto the process-0 clock, write the single "
+                             "Perfetto timeline to --out, and print the "
+                             "merged attribution table plus the stitched "
+                             "cross-process trace count")
+    parser.add_argument("--from-flight-dump", default=None,
+                        metavar="FLIGHT.json",
+                        help="skip execution: replay a flight-recorder dump "
+                             "(attribution over its events; --out exports it "
+                             "as a Chrome trace)")
     parser.add_argument("--job-args", default="--smoke --cpu",
                         help="argv passed to each pipeline's main() "
                              "(default: '--smoke --cpu')")
@@ -84,6 +102,62 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     parser.add_argument("--table-only", action="store_true",
                         help="print only the attribution table (no JSON line)")
     args = parser.parse_args(argv)
+
+    if args.cohort:
+        if len(args.pipelines) < 2:
+            parser.error("--cohort needs >= 2 per-process trace files")
+        from flink_tensorflow_tpu.tracing.stitch import (
+            cross_process_traces,
+            merge_cohort_trace_files,
+        )
+
+        merged = merge_cohort_trace_files(args.pipelines)
+        out = args.out or "cohort.trace.json"
+        with open(out, "w") as f:
+            json.dump(merged, f)
+        events = events_from_chrome(merged)
+        stitched = cross_process_traces(merged)
+        attr = attribution(events)
+        print(f"== merged {len(args.pipelines)} process traces -> {out} "
+              f"({len(events)} events, {len(stitched)} cross-process "
+              f"traces, clock error bound "
+              f"{merged['cohort_merge']['max_error_bound_s'] * 1e6:.0f}us) ==")
+        print(format_attribution_table(attr))
+        if not args.table_only:
+            print(json.dumps({
+                "trace_file": out, "events": len(events),
+                "cross_process_traces": len(stitched),
+                "cohort_merge": merged["cohort_merge"],
+                "attribution": attr,
+            }))
+        return 0
+
+    if args.from_flight_dump is not None:
+        from flink_tensorflow_tpu.tracing.flight import (
+            flight_dump_to_chrome,
+            load_flight_dump,
+        )
+
+        doc = load_flight_dump(args.from_flight_dump)
+        events = list(doc.get("events", ())) + \
+            list(doc.get("tracer_events", ()))
+        events.sort(key=lambda ev: ev[3])
+        attr = attribution(events)
+        print(f"== flight dump {args.from_flight_dump} "
+              f"(reason={doc.get('reason')}, pid={doc.get('pid')}, "
+              f"{len(events)} events) ==")
+        print(format_attribution_table(attr))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(flight_dump_to_chrome(doc), f)
+            print(f"chrome trace -> {args.out}")
+        if not args.table_only:
+            print(json.dumps({
+                "flight_dump": args.from_flight_dump,
+                "reason": doc.get("reason"),
+                "events": len(events), "attribution": attr,
+            }))
+        return 0
 
     if args.from_file is not None:
         with open(args.from_file) as f:

@@ -20,9 +20,18 @@ on its :class:`~flink_tensorflow_tpu.core.elements.StreamRecord`
 thread-locally through :class:`ChainedOutput` direct calls, and crosses
 ``io/remote.py`` edges as a ``__trace__`` entry in the TensorValue's
 metadata (re-admitted by the receiving source with the same trace id).
-Cross-process queue spans are suppressed — monotonic clocks don't agree
-between processes — but the trace id survives, so one logical record is
-one trace cluster across the cohort.
+
+Cross-process spans: monotonic clocks don't agree between processes, so
+a foreign enqueue stamp is only usable once the cohort's clock-offset
+exchange (tracing/clocksync.py, run by the DistributedExecutor's
+telemetry service) has told this tracer the origin's offset — from then
+on ``queue``/``wire`` spans are recorded OFFSET-CORRECTED into the
+local timebase (clamped so estimation error can never produce a
+negative duration) instead of suppressed, and ``flink-tpu-trace
+--cohort`` merges the per-process trace files into one Perfetto
+timeline on the process-0 clock.  Before the offsets arrive (or on a
+non-cohort job) the old suppression applies: the trace id still
+survives, so one logical record is one trace cluster either way.
 
 Sampling is **head-based and deterministic**: the admission decision is
 made once, at the source, by a per-track counter stride derived from
@@ -105,6 +114,43 @@ class _Ring:
         self.n += 1
 
 
+def events_to_chrome(events: typing.Sequence[tuple], *,
+                     epoch: float = 0.0,
+                     process_name: str = "flink-tensorflow-tpu job") -> dict:
+    """Fold ``(track, name, ph, t0, dur, args)`` event tuples into a
+    Chrome Trace Event dict — the shared exporter behind
+    :meth:`Tracer.chrome_trace`, the flight-recorder replay, and the
+    cohort stitcher."""
+    tracks = sorted({ev[0] for ev in events})
+    tid_of = {track: i + 1 for i, track in enumerate(tracks)}
+    trace_events: typing.List[dict] = [{
+        "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+        "args": {"name": process_name},
+    }]
+    for track, tid in tid_of.items():
+        trace_events.append({
+            "ph": "M", "pid": 1, "tid": tid, "name": "thread_name",
+            "args": {"name": track},
+        })
+        trace_events.append({
+            "ph": "M", "pid": 1, "tid": tid, "name": "thread_sort_index",
+            "args": {"sort_index": tid},
+        })
+    for track, name, ph, t0, dur, args in events:
+        ev: typing.Dict[str, typing.Any] = {
+            "ph": ph, "pid": 1, "tid": tid_of[track], "name": name,
+            "ts": round((t0 - epoch) * 1e6, 3),
+        }
+        if ph == "X":
+            ev["dur"] = round(dur * 1e6, 3)
+        else:
+            ev["s"] = "t"
+        if args:
+            ev["args"] = args
+        trace_events.append(ev)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
 class Tracer:
     """One per traced job.  Thread-safe by construction: every thread
     records into its own ring; the only locks guard ring registration
@@ -131,6 +177,17 @@ class Tracer:
         self._next_id = 0
         #: Monotonic epoch: exported timestamps are relative to this.
         self.epoch = time.monotonic()
+        #: Cohort clock sync (tracing/clocksync.py): origin pid -> offset
+        #: that maps that process's monotonic readings into THIS clock
+        #: (t_local = t_origin + offset).  Plain dict swaps — readers on
+        #: record paths only ever .get(); writers replace entries whole.
+        self.clock_offsets: typing.Dict[int, float] = {}
+        self.clock_error: typing.Dict[int, float] = {}
+        #: Cohort identity recorded into the Chrome export so
+        #: ``flink-tpu-trace --cohort`` can shift this file onto the
+        #: process-0 timebase: {"process_index", "pid",
+        #: "offset_to_proc0_s", "error_bound_s", "epoch_monotonic_s"}.
+        self.cohort_meta: typing.Optional[dict] = None
 
     # -- recording (hot path when ON) -----------------------------------
     def _ring(self) -> _Ring:
@@ -164,6 +221,20 @@ class Tracer:
         if meta is not None:
             inherited = meta.pop("__trace__", None)
             if inherited is not None:
+                if type(inherited) is tuple:
+                    # io/remote edge carrying (trace_id, origin_pid,
+                    # t_send): with a known clock offset the remote
+                    # hop's wait becomes an offset-corrected queue span
+                    # on the admitting track; unsynced origins keep the
+                    # id and drop the stamp (the old suppression).
+                    trace_id, origin, t_send = inherited
+                    off = self.clock_offsets.get(origin)
+                    if off is not None and t_send:
+                        now = time.monotonic()
+                        self.span(track, "queue", min(now, t_send + off),
+                                  now, args={"trace": trace_id,
+                                             "origin": origin})
+                    return TraceContext(trace_id, _PID)
                 return TraceContext(inherited, _PID)
         with self._admit_lock:
             n = self._admit_counts.get(track, 0)
@@ -186,13 +257,31 @@ class Tracer:
     def set_current(self, ctx: typing.Optional[TraceContext]) -> None:
         self._tls.ctx = ctx
 
+    def set_clock_offset(self, pid: int, offset_s: float,
+                         error_s: float = 0.0) -> None:
+        """Register peer ``pid``'s monotonic-clock offset into THIS
+        clock (t_local = t_peer + offset_s) — from now on that origin's
+        queue/wire stamps record as offset-corrected spans."""
+        self.clock_offsets[pid] = offset_s
+        self.clock_error[pid] = error_s
+
     def queue_span(self, track: str, ctx: TraceContext, now: float) -> None:
         """The queue-wait span for a delivered record: enqueue -> dequeue.
-        Suppressed for contexts minted on a peer process (their
-        ``t_queue`` is a foreign monotonic reading)."""
-        if ctx.origin == _PID and ctx.t_queue:
+        A context minted on a peer process carries a foreign monotonic
+        ``t_queue``: with a known clock offset for the origin it records
+        offset-corrected (clamped into [.., now] so estimation error
+        cannot yield a negative duration); without one it is suppressed
+        exactly as before the cohort sync existed."""
+        if not ctx.t_queue:
+            return
+        if ctx.origin == _PID:
             self.span(track, "queue", ctx.t_queue, now,
                       args={"trace": ctx.trace_id})
+            return
+        off = self.clock_offsets.get(ctx.origin)
+        if off is not None:
+            self.span(track, "queue", min(now, ctx.t_queue + off), now,
+                      args={"trace": ctx.trace_id, "origin": ctx.origin})
 
     # -- export ----------------------------------------------------------
     def events(self) -> typing.List[tuple]:
@@ -214,37 +303,16 @@ class Tracer:
         """Chrome Trace Event Format (the JSON object form) — loadable
         in Perfetto / chrome://tracing.  One named thread per track,
         complete ("X") events for spans, thread-scoped instants ("i")
-        for barriers / watermarks / sanitizer findings."""
-        events = self.events()
-        tracks = sorted({ev[0] for ev in events})
-        tid_of = {track: i + 1 for i, track in enumerate(tracks)}
-        trace_events: typing.List[dict] = [{
-            "ph": "M", "pid": 1, "tid": 0, "name": "process_name",
-            "args": {"name": "flink-tensorflow-tpu job"},
-        }]
-        for track, tid in tid_of.items():
-            trace_events.append({
-                "ph": "M", "pid": 1, "tid": tid, "name": "thread_name",
-                "args": {"name": track},
-            })
-            trace_events.append({
-                "ph": "M", "pid": 1, "tid": tid, "name": "thread_sort_index",
-                "args": {"sort_index": tid},
-            })
-        epoch = self.epoch
-        for track, name, ph, t0, dur, args in events:
-            ev: typing.Dict[str, typing.Any] = {
-                "ph": ph, "pid": 1, "tid": tid_of[track], "name": name,
-                "ts": round((t0 - epoch) * 1e6, 3),
-            }
-            if ph == "X":
-                ev["dur"] = round(dur * 1e6, 3)
-            else:
-                ev["s"] = "t"
-            if args:
-                ev["args"] = args
-            trace_events.append(ev)
-        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        for barriers / watermarks / sanitizer findings.  A cohort
+        tracer's export carries its ``cohort`` block (process index, pid,
+        clock offset, epoch) so ``flink-tpu-trace --cohort`` can merge
+        per-process files onto one timebase."""
+        trace = events_to_chrome(self.events(), epoch=self.epoch)
+        if self.cohort_meta is not None:
+            meta = dict(self.cohort_meta)
+            meta.setdefault("epoch_monotonic_s", self.epoch)
+            trace["cohort"] = meta
+        return trace
 
     def export(self, path: str) -> str:
         """Write the Chrome trace JSON atomically (tmp + rename); returns

@@ -1,0 +1,52 @@
+"""Worker process for the cohort trace-stitching tests.
+
+One process of a 2-process cohort running ``source(par 1, process 0)
+-> rebalance -> map(par 2, one subtask per process) -> sink(par 1,
+process 0)`` with tracing on: the round-robin rebalance edge GUARANTEES
+half the records cross the process boundary (keyed edges with few small
+integer keys can land entirely in process 0's key-group range), and the
+map.1 -> sink.0 edge crosses back — so the exported per-process trace
+files hold genuinely cross-process record journeys for
+``flink-tpu-trace --cohort`` stitching.
+"""
+
+import argparse
+
+from flink_tensorflow_tpu.utils.platform import force_cpu
+
+force_cpu(1)
+
+from flink_tensorflow_tpu import (  # noqa: E402
+    DistributedConfig,
+    StreamExecutionEnvironment,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--index", type=int, required=True)
+    p.add_argument("--ports", required=True)
+    p.add_argument("--n", type=int, default=120)
+    p.add_argument("--throttle", type=float, default=0.01)
+    p.add_argument("--trace", required=True)
+    p.add_argument("--telemetry-interval", type=float, default=0.2)
+    args = p.parse_args()
+
+    ports = [int(x) for x in args.ports.split(",")]
+    peers = tuple(f"127.0.0.1:{pt}" for pt in ports)
+    env = StreamExecutionEnvironment(parallelism=1)
+    env.configure(source_throttle_s=args.throttle, trace=True,
+                  trace_path=args.trace)
+    env.set_distributed(DistributedConfig(
+        args.index, len(ports), peers, connect_timeout_s=30.0,
+        telemetry_interval_s=args.telemetry_interval))
+    (
+        env.from_collection(list(range(args.n)), parallelism=1)
+        .map(lambda x: x + 1, name="work", parallelism=2)
+        .sink_to_callable(lambda v: None, name="sink", parallelism=1)
+    )
+    env.execute("cohort-trace", timeout=180)
+
+
+if __name__ == "__main__":
+    main()

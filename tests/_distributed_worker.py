@@ -274,14 +274,26 @@ def main():
                             "event_time", "interval_join"))
     p.add_argument("--window", type=int, default=5)
     p.add_argument("--par", type=int, default=2, help="keyed-stage parallelism")
+    p.add_argument("--trace", default=None,
+                   help="export a span trace: the executor suffixes this "
+                        "path .proc<k> per cohort process (cohort "
+                        "telemetry tests stitch them)")
+    p.add_argument("--flight", default=None,
+                   help="flight-recorder dump path for this process")
+    p.add_argument("--telemetry-interval", type=float, default=2.0)
     args = p.parse_args()
 
     ports = [int(x) for x in args.ports.split(",")]
     peers = tuple(f"127.0.0.1:{pt}" for pt in ports)
     env = StreamExecutionEnvironment(parallelism=1)
     env.configure(source_throttle_s=args.throttle)
-    env.set_distributed(DistributedConfig(args.index, len(ports), peers,
-                                          connect_timeout_s=30.0))
+    if args.trace:
+        env.configure(trace=True, trace_path=args.trace)
+    if args.flight:
+        env.configure(flight_path=args.flight)
+    env.set_distributed(DistributedConfig(
+        args.index, len(ports), peers, connect_timeout_s=30.0,
+        telemetry_interval_s=args.telemetry_interval))
     if args.chk:
         env.enable_checkpointing(args.chk, every_n_records=args.every)
     if args.die_after_checkpoint > 0 and args.chk:

@@ -1,0 +1,758 @@
+"""Cohort telemetry plane (PR 9).
+
+Pins the three layers of the cross-process observability plane:
+
+- **Clock-offset estimation** (tracing/clocksync.py): NTP-style
+  midpoint estimates stay within the classical half-RTT error bound
+  under injected skew and asymmetric wire legs; min-RTT retention and
+  aging behave.
+- **Trace stitching** (tracing/stitch.py + the telemetry service): a
+  REAL 2-process cohort job exports per-process trace files whose
+  merge yields offset-corrected, monotonically ordered cross-process
+  ``emit -> ... -> queue -> process`` record journeys — no suppressed
+  foreign-clock spans.
+- **Distributed metric aggregation** (metrics/cohort.py): meters and
+  counters sum, histogram reservoirs merge deterministically, gauges
+  follow the per-name policy; the process-0 collector is the
+  programmatic supervisor feed.
+- **Flight recorder** (tracing/flight.py): always-on ring, dumped on
+  induced crash / cancel / SIGTERM, replayable by ``flink-tpu-trace
+  --from-flight-dump``; ``flight_recorder=False`` is a zero-alloc off
+  path (tier-1 guard mirroring the tracer's).
+"""
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment  # noqa: E402
+from flink_tensorflow_tpu.metrics.cohort import (  # noqa: E402
+    CohortCollector,
+    gauge_policy,
+    merge_states,
+    state_to_snapshot,
+)
+from flink_tensorflow_tpu.metrics.registry import MetricRegistry  # noqa: E402
+from flink_tensorflow_tpu.tracing.clocksync import OffsetEstimator  # noqa: E402
+from flink_tensorflow_tpu.tracing.flight import (  # noqa: E402
+    FlightRecorder,
+    ShutdownFlusher,
+    load_flight_dump,
+)
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_cohort_trace_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------------------
+
+
+class TestOffsetEstimator:
+    def test_symmetric_legs_recover_skew_exactly(self):
+        est = OffsetEstimator()
+        skew, leg = 3.7, 0.002  # remote clock = local + skew
+        t0 = 100.0
+        assert est.add_sample(t0, t0 + leg + skew, t0 + 2 * leg, now=0.0)
+        assert est.offset_s == pytest.approx(skew, abs=1e-12)
+        assert est.error_bound_s == pytest.approx(leg)
+
+    def test_error_within_half_rtt_under_asymmetric_legs(self):
+        """The midpoint estimate's error is |d1-d2|/2 <= rtt/2 — the
+        recorded bound must hold for EVERY accepted sample under
+        adversarial leg asymmetry and injected skew."""
+        rng = np.random.RandomState(42)
+        for _ in range(200):
+            skew = float(rng.uniform(-1e4, 1e4))
+            d1 = float(rng.uniform(1e-5, 5e-3))
+            d2 = float(rng.uniform(1e-5, 5e-3))
+            est = OffsetEstimator()
+            t0 = float(rng.uniform(0, 1e3))
+            assert est.add_sample(t0, t0 + d1 + skew, t0 + d1 + d2, now=0.0)
+            assert abs(est.offset_s - skew) <= est.error_bound_s + 1e-12
+
+    def test_min_rtt_sample_wins(self):
+        est = OffsetEstimator()
+        est.add_sample(0.0, 0.05, 0.10, now=0.0)      # rtt 100ms
+        assert est.error_bound_s == pytest.approx(0.05)
+        # Worse RTT within the freshness window: rejected.
+        assert not est.add_sample(1.0, 1.2, 1.4, now=1.0)
+        assert est.error_bound_s == pytest.approx(0.05)
+        # Tighter RTT: replaces.
+        assert est.add_sample(2.0, 2.001, 2.002, now=2.0)
+        assert est.error_bound_s == pytest.approx(0.001)
+
+    def test_stale_best_yields_to_fresh_sample(self):
+        """Drift tracking: a minute-old tight bound must not pin the
+        estimate forever — any fresh sample replaces an aged-out best."""
+        est = OffsetEstimator(max_age_s=10.0)
+        est.add_sample(0.0, 0.001, 0.002, now=0.0)    # tight, rtt 2ms
+        tight = est.error_bound_s
+        assert not est.add_sample(1.0, 1.05, 1.1, now=5.0)  # fresh enough
+        assert est.add_sample(20.0, 20.05, 20.1, now=20.0)  # best aged out
+        assert est.error_bound_s > tight
+
+    def test_negative_rtt_rejected(self):
+        est = OffsetEstimator()
+        assert not est.add_sample(5.0, 5.0, 4.9, now=0.0)
+        assert not est.ready
+        assert est.samples == 0
+
+
+# ---------------------------------------------------------------------------
+# metric-state merge semantics
+# ---------------------------------------------------------------------------
+
+
+def _registry_with(scope, *, records=0, lat_samples=(), gauges=()):
+    reg = MetricRegistry(seed=7)
+    g = reg.group(scope)
+    m = g.meter("records_in")
+    for _ in range(records):
+        m.mark()
+    h = g.histogram("lat")
+    for s in lat_samples:
+        h.record(s)
+    for name, value in gauges:
+        g.gauge(name, (lambda v=value: v))
+    return reg
+
+
+class TestMergeSemantics:
+    def test_gauge_policy_table(self):
+        assert gauge_policy("backpressure_s") == "sum"        # accumulated
+        assert gauge_policy("queue_depth") == "sum"
+        assert gauge_policy("send_queue_bytes") == "sum"
+        assert gauge_policy("watermark_lag") == "max"          # unrecognized
+        assert gauge_policy("queue_high_watermark") == "max"
+        assert gauge_policy("chain_length") == "last"
+        # Reactor lag gauges: level, not accumulated — worst process.
+        assert gauge_policy("poll_to_dispatch_s") == "max"
+        assert gauge_policy("max_poll_to_dispatch_s") == "max"
+
+    def test_meters_and_counters_sum_across_processes(self):
+        a = _registry_with("wire", records=10).export_state()
+        b = _registry_with("wire", records=32).export_state()
+        merged = state_to_snapshot(merge_states([a, b]))
+        assert merged["wire"]["records_in"]["count"] == 42
+
+    def test_disjoint_subtask_scopes_union(self):
+        a = _registry_with("op.0", records=5).export_state()
+        b = _registry_with("op.1", records=7).export_state()
+        merged = state_to_snapshot(merge_states([a, b]))
+        assert merged["op.0"]["records_in"]["count"] == 5
+        assert merged["op.1"]["records_in"]["count"] == 7
+
+    def test_reservoir_merge_is_deterministic_concatenation(self):
+        a = _registry_with("op.0", lat_samples=[1.0, 2.0]).export_state()
+        b = _registry_with("op.0", lat_samples=[3.0, 4.0]).export_state()
+        m1 = merge_states([a, b])
+        m2 = merge_states([a, b])
+        assert m1 == m2  # same inputs, same order -> identical merge
+        kind, payload = m1["op.0"]["lat"]
+        assert kind == "histogram"
+        assert payload["samples"] == [1.0, 2.0, 3.0, 4.0]
+        # Percentiles come from the MERGED sample set, not averaged
+        # per-process percentiles.
+        snap = state_to_snapshot(m1)
+        assert snap["op.0"]["lat"]["p50"] == pytest.approx(2.5)
+
+    def test_gauges_follow_policy(self):
+        a = _registry_with("op.0", gauges=[
+            ("backpressure_s", 2.0), ("queue_high_watermark", 5),
+            ("chain_length", 3)]).export_state()
+        b = _registry_with("op.0", gauges=[
+            ("backpressure_s", 3.0), ("queue_high_watermark", 9),
+            ("chain_length", 4)]).export_state()
+        snap = state_to_snapshot(merge_states([a, b]))
+        assert snap["op.0"]["backpressure_s"] == pytest.approx(5.0)  # sum
+        assert snap["op.0"]["queue_high_watermark"] == 9             # max
+        assert snap["op.0"]["chain_length"] == 4                     # last
+
+    def test_export_state_strides_large_reservoirs(self):
+        reg = _registry_with("op.0", lat_samples=range(2000))
+        state = reg.export_state(max_samples=100)
+        _, payload = state["op.0"]["lat"]
+        assert payload["count"] == 2000
+        assert len(payload["samples"]) <= 100
+        # Deterministic: the same registry exports identical state.
+        assert state == reg.export_state(max_samples=100)
+
+    def test_collector_is_the_supervisor_feed(self):
+        reg0 = _registry_with("op.0", records=10)
+        collector = CohortCollector(reg0, 0, num_processes=3)
+        collector.on_push(1, 1, _registry_with("op.1", records=20).export_state())
+        collector.on_push(2, 1, _registry_with("op.2", records=30).export_state())
+        # Stale sequence replays are dropped (control-channel reconnect).
+        collector.on_push(1, 1, _registry_with("op.1", records=999).export_state())
+        ts, snap = collector.merged_snapshot()
+        assert collector.peers_reporting == [1, 2]
+        assert snap["op.0"]["records_in"]["count"] == 10
+        assert snap["op.1"]["records_in"]["count"] == 20
+        assert snap["op.2"]["records_in"]["count"] == 30
+        # The merged tree renders through the standard inspector fold —
+        # the `--live --cohort` table and the autoscaling supervisor
+        # read the same shape.
+        from flink_tensorflow_tpu.metrics.inspector import (
+            build_live_rows,
+            format_live_table,
+        )
+
+        rows = build_live_rows(snap)
+        assert [(r["operator"], r["subtask"]) for r in rows] == [
+            ("op", 0), ("op", 1), ("op", 2)]
+        assert "op.1" in format_live_table(rows)
+
+
+# ---------------------------------------------------------------------------
+# telemetry service loopback (two services wired in threads)
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryServiceLoopback:
+    def test_sync_pushes_and_offsets(self):
+        from flink_tensorflow_tpu.core.cohort_telemetry import (
+            CohortTelemetryService,
+        )
+        from flink_tensorflow_tpu.tracing.tracer import Tracer
+
+        reg0 = _registry_with("op.0", records=4)
+        reg1 = _registry_with("op.1", records=6)
+        tr0, tr1 = Tracer(), Tracer()
+        services = {}
+
+        def send_from(idx):
+            def _send(peer, message):
+                services[peer].on_control(idx, message)
+            return _send
+
+        # Distinct fake pids: both services live in ONE process here.
+        services[0] = CohortTelemetryService(
+            process_index=0, num_processes=2, pid=11111,
+            send=send_from(0), registry=reg0, tracer=tr0,
+            interval_s=0.05)
+        services[1] = CohortTelemetryService(
+            process_index=1, num_processes=2, pid=22222,
+            send=send_from(1), registry=reg1, tracer=tr1,
+            interval_s=0.05)
+        try:
+            services[0].start()
+            services[1].start()
+            assert services[1].synced.wait(10.0), "peer never clock-synced"
+            deadline = time.monotonic() + 10.0
+            while (services[0].collector.pushes == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            # Same physical clock on both ends: the true offset is 0, so
+            # the estimate itself must sit within its own error bound.
+            est = services[1].estimator
+            assert est.ready
+            assert abs(est.offset_s) <= est.error_bound_s + 1e-3
+            # Both tracers learned the other pid's offset: foreign-clock
+            # queue spans are now correctable on either side.
+            assert 22222 in tr0.clock_offsets
+            assert 11111 in tr1.clock_offsets
+            assert tr1.cohort_meta["process_index"] == 1
+            # The collector merged both processes' scopes — the feed.
+            _, snap = services[0].collector.merged_snapshot()
+            assert snap["op.0"]["records_in"]["count"] == 4
+            assert snap["op.1"]["records_in"]["count"] == 6
+        finally:
+            services[0].stop()
+            services[1].stop()
+
+
+# ---------------------------------------------------------------------------
+# 2-process cohort: offset-corrected stitching end to end
+# ---------------------------------------------------------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(index, ports, trace, n=120, throttle=0.01):
+    cmd = [
+        sys.executable, _WORKER, "--index", str(index),
+        "--ports", ",".join(map(str, ports)),
+        "--n", str(n), "--throttle", str(throttle),
+        "--telemetry-interval", "0.2", "--trace", trace,
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO), env.get("PYTHONPATH", "")])
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def _wait(proc, timeout=120):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError(f"worker hung:\n{out.decode(errors='replace')}")
+    return proc.returncode, out.decode(errors="replace")
+
+
+class TestCohortStitching:
+    @pytest.fixture(scope="class")
+    def cohort_traces(self, tmp_path_factory):
+        """One real 2-process keyed job, traced: returns the two
+        per-process trace file paths."""
+        tmp = tmp_path_factory.mktemp("cohort")
+        ports = _free_ports(2)
+        trace = str(tmp / "t.json")
+        procs = [_spawn(i, ports, trace) for i in range(2)]
+        for p in procs:
+            rc, log = _wait(p)
+            assert rc == 0, f"worker failed:\n{log}"
+        paths = [f"{tmp}/t.proc{i}.json" for i in range(2)]
+        for p in paths:
+            assert os.path.exists(p), f"missing per-process trace {p}"
+        return paths
+
+    def test_per_process_files_carry_cohort_blocks(self, cohort_traces):
+        docs = [json.loads(pathlib.Path(p).read_text())
+                for p in cohort_traces]
+        meta0, meta1 = (d["cohort"] for d in docs)
+        assert meta0["process_index"] == 0
+        assert meta0["offset_to_proc0_s"] == 0.0
+        assert meta1["process_index"] == 1
+        # The peer clock-synced before export: a real (finite) offset
+        # estimate with a sub-second error bound, not the startup
+        # placeholder.
+        assert np.isfinite(meta1["error_bound_s"])
+        assert meta1["error_bound_s"] < 0.5
+
+    def test_merged_timeline_stitches_cross_process_records(
+            self, cohort_traces):
+        """THE acceptance criterion: the merged Perfetto timeline holds
+        record journeys whose emit -> ... -> queue -> process spans
+        cross the process boundary with offset-corrected, monotonically
+        ordered timestamps — no suppressed foreign-clock spans."""
+        from flink_tensorflow_tpu.tracing.stitch import (
+            cross_process_traces,
+            merge_cohort_trace_files,
+        )
+
+        merged = merge_cohort_trace_files(cohort_traces)
+        assert merged["cohort_merge"]["max_error_bound_s"] < 0.5
+        names = {e.get("name") for e in merged["traceEvents"]}
+        # The full stage vocabulary survives the merge (serde/wire are
+        # frame-level sender spans; emit/queue/process are per record).
+        for span in ("emit", "serde", "wire", "queue", "process"):
+            assert span in names, f"{span} span missing from merged trace"
+        stitched = cross_process_traces(merged)
+        assert stitched, "no record's spans crossed the process boundary"
+        crossing_queues = 0
+        for trace_id, spans in stitched.items():
+            # spans: (t0, t1, process_index, track, name), sorted by t0.
+            assert len({s[2] for s in spans}) == 2
+            starts = [s[0] for s in spans]
+            assert starts == sorted(starts)
+            for t0, t1, _pidx, _track, _name in spans:
+                assert t1 >= t0  # offset-corrected, never negative
+            # Journey shape: minted at the source (process 0) first...
+            assert spans[0][4] == "emit" and spans[0][2] == 0
+            # ...and the boundary crossing is an offset-corrected queue
+            # span recorded ON the downstream process with its origin.
+            for t0, t1, pidx, _track, name in spans:
+                if name == "queue" and pidx != 0:
+                    crossing_queues += 1
+                    assert t0 >= spans[0][0]
+        assert crossing_queues > 0, (
+            "cross-process queue spans were suppressed — clock sync "
+            "never reached the downstream tracer")
+
+    def test_cohort_cli_merges_and_reports(self, cohort_traces, tmp_path,
+                                           capsys):
+        from flink_tensorflow_tpu.tracing.cli import main
+
+        out = str(tmp_path / "merged.json")
+        assert main(["--cohort", *cohort_traces, "--out", out]) == 0
+        captured = capsys.readouterr().out
+        assert "cross-process" in captured
+        merged = json.loads(pathlib.Path(out).read_text())
+        assert merged["cohort_merge"]["processes"][1]["process_index"] == 1
+
+    def test_merge_refuses_non_cohort_files(self, tmp_path):
+        from flink_tensorflow_tpu.tracing.stitch import merge_cohort_trace_files
+
+        p = tmp_path / "plain.json"
+        p.write_text(json.dumps({"traceEvents": []}))
+        with pytest.raises(ValueError, match="cohort"):
+            merge_cohort_trace_files([str(p), str(p)])
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(100):
+            fr.record("t", f"e{i}")
+        events = fr.events()
+        assert len(events) == 8
+        assert events[-1][1] == "e99"  # most recent window survives
+
+    def test_metric_delta_is_per_active_scope(self):
+        fr = FlightRecorder()
+        snap = {"op.0": {"records_in": {"count": 10},
+                         "records_out": {"count": 9}, "queue_depth": 2},
+                "idle.0": {"records_in": {"count": 0},
+                           "records_out": {"count": 0}}}
+        fr.metric_delta(snap)
+        fr.metric_delta(snap)  # unchanged counts: no new events
+        deltas = [e for e in fr.events() if e[1] == "metrics.delta"]
+        assert len(deltas) == 1
+        assert deltas[0][5] == {"records_in": 10, "records_out": 9,
+                                "queue_depth": 2}
+
+    def test_dump_idempotent_per_reason(self, tmp_path):
+        fr = FlightRecorder()
+        fr.record("job", "start")
+        path = str(tmp_path / "f.json")
+        assert fr.dump(path, "crash") == path
+        assert fr.dump(path, "crash") is None  # second crash dump: no-op
+        assert fr.dump(str(tmp_path / "g.json"), "signal") is not None
+
+    def test_crash_dumps_black_box(self, tmp_path):
+        """Induced worker crash -> flight dump on disk, parseable and
+        replayable by flink-tpu-trace --from-flight-dump."""
+        from flink_tensorflow_tpu.core.runtime import JobFailure
+
+        dump = str(tmp_path / "flight.json")
+        env = StreamExecutionEnvironment().configure(flight_path=dump)
+
+        def boom(x):
+            if x >= 50:
+                raise RuntimeError("synthetic crash")
+            return x
+
+        (env.from_collection(list(range(200)))
+            .map(boom, name="boom")
+            .sink_to_callable(lambda v: None))
+        with pytest.raises(JobFailure):
+            env.execute("t", timeout=60)
+        doc = load_flight_dump(dump)
+        assert doc["reason"] == "crash"
+        names = [e[1] for e in doc["events"]]
+        assert "start" in names and "failure" in names
+        failure = next(e for e in doc["events"] if e[1] == "failure")
+        assert "synthetic crash" in failure[5]["error"]
+        # Replay through the trace CLI.
+        from flink_tensorflow_tpu.tracing.cli import main
+
+        assert main(["--from-flight-dump", dump,
+                     "--out", str(tmp_path / "replay.json")]) == 0
+        chrome = json.loads((tmp_path / "replay.json").read_text())
+        assert any(e.get("name") == "failure"
+                   for e in chrome["traceEvents"])
+
+    def test_cancel_dumps(self, tmp_path):
+        dump = str(tmp_path / "flight.json")
+        env = StreamExecutionEnvironment().configure(
+            flight_path=dump, source_throttle_s=0.01)
+        (env.from_collection(list(range(50_000)))
+            .map(lambda x: x, name="m")
+            .sink_to_callable(lambda v: None))
+        handle = env.execute_async("t")
+        time.sleep(0.3)
+        handle.cancel()
+        assert load_flight_dump(dump)["reason"] == "cancel"
+
+    def test_sigterm_flushes_reporter_and_dumps(self, tmp_path):
+        """Graceful-shutdown satellite: a SIGTERM'd worker keeps its
+        final reporting interval (reporter flush) AND its black box
+        (flight dump reason=signal) — then still dies of SIGTERM."""
+        dump = tmp_path / "flight.json"
+        jsonl = tmp_path / "reports.jsonl"
+        script = f"""
+import os, signal, time
+from flink_tensorflow_tpu.utils.platform import force_cpu
+force_cpu(1)
+import dataclasses
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+
+env = StreamExecutionEnvironment().configure(
+    flight_path={str(dump)!r}, source_throttle_s=0.005)
+env.configure(metrics=dataclasses.replace(
+    env.config.metrics, report_interval_s=0.1, jsonl_path={str(jsonl)!r}))
+(env.from_collection(list(range(100000)))
+    .map(lambda x: x, name="m")
+    .sink_to_callable(lambda v: None))
+handle = env.execute_async("sig")
+time.sleep(1.0)  # records flowing, several reports landed
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(30)  # never reached: the re-raised SIGTERM kills us
+"""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(REPO), env.get("PYTHONPATH", "")])
+        proc = subprocess.run(
+            [sys.executable, "-c", script], env=env, timeout=120,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        assert proc.returncode == -signal.SIGTERM, (
+            f"expected death by SIGTERM:\n{proc.stdout.decode(errors='replace')}")
+        doc = load_flight_dump(str(dump))
+        assert doc["reason"] == "signal"
+        reports = [json.loads(line)
+                   for line in jsonl.read_text().splitlines() if line]
+        assert reports, "reporter never flushed before death"
+        # The signal-time flush captured in-flight progress.
+        last = reports[-1]
+        scopes = last.get("metrics", last)
+        assert any("records_in" in (v or {}) for v in scopes.values()
+                   if isinstance(v, dict))
+
+    def test_shutdown_flusher_mechanics(self):
+        ran = []
+        flusher = ShutdownFlusher([lambda: ran.append(1),
+                                   lambda: 1 / 0,  # must not mask the rest
+                                   lambda: ran.append(2)])
+        flusher.flush()
+        assert ran == [1, 2]
+        assert flusher.install()  # main thread: ok
+        try:
+            assert not flusher.install()  # idempotent
+        finally:
+            flusher.uninstall()
+        # Off the main thread the signal module refuses — install is a
+        # clean no-op, not a crash.
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(ShutdownFlusher([]).install()))
+        t.start()
+        t.join()
+        assert results == [False]
+
+    def test_off_path_is_zero_alloc(self):
+        """Tier-1 guard (mirrors the tracer's): flight_recorder=False
+        allocates NOTHING in tracing/flight.py at runtime."""
+        import flink_tensorflow_tpu.tracing.flight  # noqa: F401  (pre-import)
+
+        def build():
+            env = StreamExecutionEnvironment().configure(
+                flight_recorder=False, trace=False)
+            out = []
+            (env.from_collection(list(range(200)))
+                .map(lambda x: x + 1, name="inc")
+                .sink_to_callable(out.append))
+            return env, out
+
+        # Warm-up run OUTSIDE the tracemalloc window: one-time lazy
+        # caches (env lookups, logging) populate here; the guarded run
+        # measures the steady-state off path.
+        warm_env, _ = build()
+        warm_env.execute("warmup", timeout=60)
+        env, out = build()
+        tracemalloc.start()
+        try:
+            handle = env.execute_async("t")
+            handle.wait(60)
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        assert len(out) == 200
+        assert handle.executor.flight is None
+        pkg = str(REPO / "flink_tensorflow_tpu" / "tracing")
+        stats = snap.filter_traces(
+            [tracemalloc.Filter(True, pkg + "/flight.py")]).statistics("filename")
+        assert sum(s.size for s in stats) == 0, stats
+
+    def test_default_on_and_env_override(self, monkeypatch):
+        env = StreamExecutionEnvironment()
+        out = []
+        (env.from_collection([1, 2, 3]).sink_to_callable(out.append))
+        handle = env.execute_async("t")
+        handle.wait(60)
+        assert handle.executor.flight is not None  # always-on default
+        assert any(e[1] == "start" for e in handle.executor.flight.events())
+        monkeypatch.setenv("FLINK_TPU_FLIGHT", "0")
+        env2 = StreamExecutionEnvironment()
+        (env2.from_collection([1]).sink_to_callable(lambda v: None))
+        handle2 = env2.execute_async("t")
+        handle2.wait(60)
+        assert handle2.executor.flight is None
+
+
+# ---------------------------------------------------------------------------
+# reactor observability satellite
+# ---------------------------------------------------------------------------
+
+
+class TestReactorObservability:
+    def test_reactor_and_writer_gauges_registered(self):
+        from flink_tensorflow_tpu.core import elements as el
+        from flink_tensorflow_tpu.core.channels import InputGate
+        from flink_tensorflow_tpu.core.shuffle import (
+            RemoteChannelWriter,
+            ShuffleServer,
+        )
+
+        reg = MetricRegistry(seed=0)
+        gate = InputGate(2, capacity=64)
+        server = ShuffleServer("127.0.0.1", metrics=reg)
+        server.register_gate("op", 1, gate)
+        server.start()
+        try:
+            w = RemoteChannelWriter("127.0.0.1", server.port, "op", 1, 1,
+                                    connect_timeout_s=10.0, metrics=reg)
+            for i in range(5):
+                w.write(el.StreamRecord(i))
+            w.write(el.EndOfPartition())
+            seen = 0
+            while seen < 6:
+                item = gate.poll(timeout=10.0)
+                assert item is not None
+                seen += 1
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                snap = reg.snapshot()
+                if snap.get("reactor", {}).get("dispatches"):
+                    break
+                time.sleep(0.01)
+            snap = reg.snapshot()
+            # Event-loop lag gauges in the standard scope tree — they
+            # ride reporters, the inspector, and cohort pushes for free.
+            reactor = snap["reactor"]
+            assert reactor["dispatches"] >= 1
+            assert reactor["poll_to_dispatch_s"] >= 0.0
+            assert (reactor["max_poll_to_dispatch_s"]
+                    >= reactor["poll_to_dispatch_s"])
+            assert reactor["connections"] >= 1
+            out_scope = snap["shuffle.out.op.1.ch1"]
+            assert out_scope["send_queue_depth"] == 0  # drained
+            assert out_scope["send_queue_bytes"] == 0
+            in_scope = snap["shuffle.in.op.1.ch1"]
+            assert in_scope["gate_paused"] >= 0
+            w.close()
+        finally:
+            server.close()
+
+    def test_full_gate_pause_ticks_counter(self):
+        from flink_tensorflow_tpu.core import elements as el
+        from flink_tensorflow_tpu.core.channels import InputGate
+        from flink_tensorflow_tpu.core.shuffle import (
+            RemoteChannelWriter,
+            ShuffleServer,
+        )
+
+        reg = MetricRegistry(seed=0)
+        gate = InputGate(1, capacity=2)  # tiny: fills immediately
+        server = ShuffleServer("127.0.0.1", metrics=reg)
+        server.register_gate("op", 0, gate)
+        server.start()
+        try:
+            w = RemoteChannelWriter("127.0.0.1", server.port, "op", 0, 0,
+                                    connect_timeout_s=10.0,
+                                    flush_bytes=0)  # per-record frames
+            for i in range(64):
+                w.write(el.StreamRecord(i))
+            # Un-drained gate fills; delivery pauses; counter ticks.
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                snap = reg.snapshot().get("shuffle.in.op.0.ch0", {})
+                if (snap.get("gate_paused") or 0) >= 1:
+                    break
+                time.sleep(0.01)
+            assert (reg.snapshot()["shuffle.in.op.0.ch0"]["gate_paused"]
+                    >= 1), "full-gate pause never counted"
+            # Drain so teardown isn't fighting backpressure.
+            for _ in range(64):
+                if gate.poll(timeout=5.0) is None:
+                    break
+            w.close()
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# cohort-telemetry lint
+# ---------------------------------------------------------------------------
+
+
+def _lint(env):
+    from flink_tensorflow_tpu.analysis import analyze
+
+    diags = analyze(env.graph, config=env.config)
+    return [d for d in diags if d.rule == "cohort-telemetry"]
+
+
+def _dist(telemetry_interval_s):
+    from flink_tensorflow_tpu.core.distributed import DistributedConfig
+
+    return DistributedConfig(
+        0, 2, ("127.0.0.1:9001", "127.0.0.1:9002"),
+        telemetry_interval_s=telemetry_interval_s)
+
+
+class TestCohortTelemetryLint:
+    def _plan(self, env, rate_hz=None):
+        if rate_hz is None:
+            stream = env.from_collection([1, 2, 3])
+        else:
+            from flink_tensorflow_tpu.sources import PacedSplitSource
+
+            stream = env.from_source(
+                PacedSplitSource([1, 2, 3], rate_hz), name="paced")
+        stream.map(lambda x: x, name="m").sink_to_callable(lambda v: None)
+
+    def test_warns_when_telemetry_disabled_under_tracing(self):
+        env = StreamExecutionEnvironment().configure(trace=True)
+        env.set_distributed(_dist(0.0))
+        self._plan(env)
+        findings = _lint(env)
+        assert len(findings) == 1
+        assert "telemetry_interval_s" in findings[0].message
+
+    def test_clean_when_telemetry_enabled(self):
+        env = StreamExecutionEnvironment().configure(trace=True)
+        env.set_distributed(_dist(2.0))
+        self._plan(env)
+        assert _lint(env) == []
+
+    def test_clean_single_process(self):
+        env = StreamExecutionEnvironment().configure(trace=True)
+        self._plan(env)
+        assert _lint(env) == []
+
+    def test_warns_full_rate_tracing_on_high_rate_open_loop(self):
+        env = StreamExecutionEnvironment().configure(
+            trace=True, trace_sample_rate=1.0)
+        env.set_distributed(_dist(2.0))
+        self._plan(env, rate_hz=2000.0)
+        findings = _lint(env)
+        assert len(findings) == 1
+        assert "trace_sample_rate" in findings[0].message
+
+    def test_clean_when_sampled(self):
+        env = StreamExecutionEnvironment().configure(
+            trace=True, trace_sample_rate=0.01)
+        env.set_distributed(_dist(2.0))
+        self._plan(env, rate_hz=2000.0)
+        assert _lint(env) == []

@@ -483,6 +483,7 @@ class TestBackgroundFetch:
             connect_timeout_s=60.0).validate()
         ex.cancelled = _threading.Event()
         ex._control_writers = {}
+        ex._control_writers_lock = _threading.Lock()
         ex._participants = {0, 1}
         ex._durable_cv = _threading.Condition()
         ex._durable_acks = {1: {1}, 2: {1}}  # peer already announced

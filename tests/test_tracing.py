@@ -247,7 +247,12 @@ class TestPipelineTracing:
         import flink_tensorflow_tpu.tracing.attribution  # noqa: F401
         import flink_tensorflow_tpu.tracing.tracer  # noqa: F401
 
-        env = StreamExecutionEnvironment()
+        # flight_recorder=False: the PR 9 flight recorder also lives in
+        # tracing/ and is ON by default (its own off-path zero-alloc
+        # guard is in test_cohort_telemetry.py); this test isolates the
+        # TRACER's off path.
+        env = StreamExecutionEnvironment().configure(
+            flight_recorder=False)
         out = []
         (env.from_collection(list(range(200)))
             .map(lambda x: x + 1, name="inc")
