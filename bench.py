@@ -2546,6 +2546,238 @@ def bench_shuffle(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# workload 9: streaming LLM serving — continuous batching vs fixed windows
+# ---------------------------------------------------------------------------
+
+#: Full per-point serving detail lands here (the r09 booking).
+BENCH_R09_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_r09.json")
+
+
+def bench_serving(args) -> dict:
+    """Open-loop keyed session arrivals through BOTH serving arms at >=2
+    offered-load points: ``continuous`` (serving.continuous_batching —
+    admit/evict per decode step under a token budget, KV cache as keyed
+    state) vs ``fixed`` (count-window static batching: a window of
+    requests generates to completion before emitting).  Shared model,
+    schedule, buckets, and DecodeStepRunner, so every delta is the
+    scheduling policy.  Reports tokens/s, per-token p50/p95,
+    time-to-first-token, and the admitted/evicted/preempted counters;
+    the higher load point also runs TRACED in both arms and the
+    per-stage attribution tables (PR-6 tracer) land in BENCH_r09.json
+    alongside the scoreboard numbers."""
+    import jax
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment, serving
+    from flink_tensorflow_tpu.models import get_model_def
+    from flink_tensorflow_tpu.sources import PacedSplitSource
+    from flink_tensorflow_tpu.tracing.attribution import attribution
+
+    n = args.records or (48 if args.smoke else 96)
+    max_new = 28 if args.smoke else 40
+    # Both offered-load points run ABOVE the fixed arm's service
+    # capacity (the static-window arm's flood throughput), so tokens/s
+    # measures the arms' real serving rates, not the arrival schedule.
+    rates = (400.0, 1200.0)
+    capacity = 64
+    prompt_hi = 16
+    cfg = serving.ServingConfig(
+        max_active_seqs=8, token_budget=8 * 56, capacity=capacity,
+        # One prompt bucket + the graded admit ladder: prefill pays for
+        # the sessions actually admitted, and every shape pre-warms
+        # below, so the arms measure scheduling, not compile churn.
+        prompt_buckets=(prompt_hi,), admit_buckets=(1, 2, 4, 8),
+        warmup_compile=True,
+    )
+    mdef = get_model_def("char_transformer", vocab_size=64, embed_dim=64,
+                         num_heads=4, num_layers=3, capacity=capacity)
+    model = mdef.to_model(mdef.init_params(jax.random.PRNGKey(0)))
+    rng = np.random.RandomState(11)
+    requests = [
+        serving.GenerateRequest(
+            session_id=f"s{i}",
+            prompt=rng.randint(1, 64, (int(rng.randint(6, prompt_hi + 1)),)),
+            # WIDELY varied continuation lengths: a static window runs
+            # at its LONGEST member's step count while finished slots
+            # idle — exactly the waste continuous batching reclaims.
+            max_new_tokens=int(rng.randint(4, max_new + 1)),
+        )
+        for i in range(n)
+    ]
+    # Pre-warm the shared jitted decode/prefill calls ONCE: runners are
+    # per-operator but the compiled executables are process-cached
+    # (functions/runner._build_decode_calls), so every arm below opens
+    # warm and no session's latency carries an XLA compile.
+    from flink_tensorflow_tpu.functions.runner import DecodeStepRunner
+
+    _warm = DecodeStepRunner(
+        model, pool_slots=cfg.max_active_seqs, capacity=cfg.capacity,
+        prompt_buckets=cfg.resolved_prompt_buckets())
+    _warm.open()
+    _warm.warmup(cfg.resolved_admit_buckets(), cfg.resolved_prompt_buckets())
+    _warm.close()
+
+    # Shift the open-loop schedule past operator open() (executables
+    # are pre-warmed above; the delay only covers pool/params setup —
+    # same reason the flagship open-loop pass has
+    # --open-loop-start-delay-s).  ONE split: the delay applies per
+    # split read, and the arrival schedule must be a single paced
+    # sequence.
+    start_delay = 1.5
+
+    def run_arm(arm: str, rate: float, trace: bool):
+        env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
+        if trace:
+            env.configure(trace=True)
+        source = env.from_source(
+            PacedSplitSource(requests, rate, num_splits=1,
+                             start_delay_s=start_delay),
+            name="sessions", parallelism=1)
+        if arm == "continuous":
+            stream = serving.continuous_batching(
+                source.key_by(lambda r: r.session_id), model, config=cfg)
+        else:
+            stream = source.count_window(8, timeout_s=0.3).apply(
+                serving.FixedWindowGenerateFunction(model, cfg),
+                name="fixed_window_generate")
+        events = []  # (t_emit, TokenEvent)
+
+        def sink(ev):
+            events.append((time.monotonic(), ev))
+
+        stream.sink_to_callable(sink)
+        handle = env.execute_async(f"bench-serving-{arm}")
+        handle.wait(timeout=3600)
+        attr = None
+        if trace and handle.executor.tracer is not None:
+            full = attribution(handle.executor.tracer.events())
+            attr = {
+                op: {stage: {k: row[k] for k in
+                             ("count", "p50_ms", "p95_ms", "total_ms")
+                             if k in row}
+                     for stage, row in stages.items()}
+                for op, stages in full.items()
+            }
+        tok_lat, ttft = [], []
+        first_sched, last_emit = None, None
+        for t_emit, ev in events:
+            sched = ev.meta.get("sched_ts")
+            if sched is None or ev.index < 0:
+                continue
+            first_sched = sched if first_sched is None else min(first_sched, sched)
+            last_emit = t_emit if last_emit is None else max(last_emit, t_emit)
+            tok_lat.append((t_emit - sched) * 1000.0)
+            if ev.index == 0:
+                ttft.append((t_emit - sched) * 1000.0)
+        span = (last_emit - first_sched) if tok_lat else None
+        rep = env.metric_registry.report()
+
+        def ctr(name):
+            return sum(v for k, v in rep.items() if k.endswith("." + name))
+
+        out = {
+            "arm": arm,
+            "offered_rate_rps": rate,
+            "sessions": len({ev.session_id for _, ev in events}),
+            "tokens": len(tok_lat),
+            "tokens_per_s": (round(len(tok_lat) / span, 1)
+                             if span else None),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 2) if ttft else None,
+            "ttft_p95_ms": round(float(np.percentile(ttft, 95)), 2) if ttft else None,
+            "token_p50_ms": round(float(np.percentile(tok_lat, 50)), 2) if tok_lat else None,
+            "token_p95_ms": round(float(np.percentile(tok_lat, 95)), 2) if tok_lat else None,
+        }
+        if arm == "continuous":
+            out.update({
+                "admitted": ctr("admitted"),
+                "evicted": ctr("evicted"),
+                "preempted": ctr("preempted"),
+                "rejected": ctr("rejected"),
+                "serving_steps": ctr("serving_steps"),
+                "step_h2d_bytes": ctr("step_h2d_bytes"),
+                "cache_h2d_blocks": ctr("cache_h2d_blocks"),
+                "cache_d2h_blocks": ctr("cache_d2h_blocks"),
+            })
+        return out, attr
+
+    points = []
+    attr_tables = {}
+    for i, rate in enumerate(rates):
+        traced = _trace_enabled(args) or i == len(rates) - 1
+        fixed, attr_f = run_arm("fixed", rate, traced)
+        cont, attr_c = run_arm("continuous", rate, traced)
+        if attr_f is not None:
+            attr_tables[f"fixed@{rate:g}"] = attr_f
+        if attr_c is not None:
+            attr_tables[f"continuous@{rate:g}"] = attr_c
+        dom_tok = (cont["tokens_per_s"] or 0) > (fixed["tokens_per_s"] or 0)
+        dom_ttft = (cont["ttft_p50_ms"] or 1e9) < (fixed["ttft_p50_ms"] or 0)
+        points.append({
+            "offered_rate_rps": rate,
+            "fixed": fixed,
+            "continuous": cont,
+            "continuous_dominates_tokens_per_s": dom_tok,
+            "continuous_dominates_ttft": dom_ttft,
+            "ttft_p50_speedup": (
+                round(fixed["ttft_p50_ms"] / cont["ttft_p50_ms"], 2)
+                if cont.get("ttft_p50_ms") and fixed.get("ttft_p50_ms")
+                else None),
+        })
+
+    detail = {
+        "workload": "serving",
+        "model": {"architecture": "char_transformer",
+                  "capacity": capacity, "max_new_tokens": max_new,
+                  "sessions": n},
+        "config": {"max_active_seqs": cfg.max_active_seqs,
+                   "token_budget": cfg.token_budget,
+                   "capacity": cfg.capacity,
+                   "padding_buckets": cfg.padding_buckets},
+        "points": points,
+        "trace_attribution": attr_tables,
+    }
+    # Book the round's serving evidence (write-then-rename, same
+    # contract as BENCH_full.json: never truncate a good prior file).
+    try:
+        tmp = BENCH_R09_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(detail), f, allow_nan=False, indent=1)
+        os.replace(tmp, BENCH_R09_PATH)
+        booked = "BENCH_r09.json"
+    except OSError:
+        booked = None
+    top = points[-1]
+    return {
+        "metric": "serving_tokens_per_s_continuous",
+        "value": top["continuous"]["tokens_per_s"],
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "chaining": "on" if _chaining_enabled(args) else "off",
+        "points": [
+            {"rate": p["offered_rate_rps"],
+             "tokens_per_s": [p["fixed"]["tokens_per_s"],
+                              p["continuous"]["tokens_per_s"]],
+             "ttft_p50_ms": [p["fixed"]["ttft_p50_ms"],
+                             p["continuous"]["ttft_p50_ms"]],
+             "dominates": p["continuous_dominates_tokens_per_s"]
+             and p["continuous_dominates_ttft"]}
+            for p in points
+        ],
+        "counters": {k: top["continuous"].get(k) for k in
+                     ("admitted", "evicted", "preempted", "rejected",
+                      "serving_steps")},
+        "continuous_dominates_all_points": all(
+            p["continuous_dominates_tokens_per_s"]
+            and p["continuous_dominates_ttft"] for p in points),
+        "full_detail": booked,
+        "baseline_note": (
+            "fixed arm IS the baseline: count-window static batching "
+            "(the BiLSTM idiom applied to generation) — window fill + "
+            "run-to-completion before any token emits"),
+    }
+
+
 WORKLOADS = {
     "inception": bench_inception,
     "mnist": bench_mnist,
@@ -2555,6 +2787,7 @@ WORKLOADS = {
     "filesplit": bench_filesplit,
     "deviceres": bench_deviceres,
     "shuffle": bench_shuffle,
+    "serving": bench_serving,
 }
 
 #: --workload aliases, resolved before dispatch ("all" never expands

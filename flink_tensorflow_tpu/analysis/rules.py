@@ -736,6 +736,71 @@ def _cohort_telemetry(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
+@rule("serving-unkeyed-input", Severity.ERROR)
+def _serving_unkeyed_input(ctx: AnalysisContext, emit: Emit) -> None:
+    """The continuous-batching operator keys EVERYTHING on the session
+    id: the KV cache, the generation progress, the admission queue all
+    live in keyed state.  Fed by any partitioner other than a hash
+    edge, two requests of one session (or a rescaled restore's replay)
+    can land on different subtasks — each would prefill its own cache
+    and the session's generation forks silently.  Stricter than the
+    generic keyed-partitioning rule: it also fires when the operator
+    was wired WITHOUT a key selector at all (a hand-built plan that
+    bypassed ``serving.continuous_batching``)."""
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        if not getattr(op, "is_continuous_batching", False):
+            continue
+        if getattr(op, "key_selector", None) is None:
+            emit(
+                "continuous-batching operator has no session key selector "
+                "— requests cannot be routed consistently and keyed KV "
+                "state never rescales; build the operator via "
+                "serving.continuous_batching(stream.key_by(session_id), ...)",
+                node=t.name,
+            )
+        for e in t.inputs:
+            if not isinstance(e.partitioner, HashPartitioner):
+                emit(
+                    f"continuous-batching operator sits on a "
+                    f"{type(e.partitioner).__name__} edge — requests of one "
+                    "session may land on different subtasks and fork the "
+                    "session's KV cache; key the edge by session id "
+                    "(stream.key_by(lambda r: r.session_id))",
+                    node=t.name, edge=_edge_str(e, t),
+                )
+
+
+@rule("serving-recompile-churn", Severity.WARN)
+def _serving_recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
+    """Serving shapes must quantize or every step recompiles: with
+    ``ServingConfig.padding_buckets`` disabled, the decode step runs at
+    the EXACT active-set size (a fresh executable per distinct count —
+    up to ``max_active_seqs`` compiles churning as sessions come and
+    go) and prefill at the exact prompt length (one compile per
+    distinct length in the traffic).  The bucketed mode pays padding
+    FLOPs for a bounded executable set: one decode shape ever, prefill
+    on the admit x prompt-length bucket grid.  Covers both the
+    continuous-batching operator and the fixed-window baseline arm
+    (any operator/function carrying a ``serving_config``)."""
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        cfg = getattr(op, "serving_config", None)
+        if cfg is None:
+            cfg = getattr(ctx.function_of(t), "serving_config", None)
+        if cfg is None or cfg.padding_buckets:
+            continue
+        emit(
+            "padding buckets are disabled (ServingConfig."
+            "padding_buckets=False) — every distinct active-set size "
+            "compiles a fresh decode executable and every distinct "
+            "prompt length a fresh prefill; enable padding_buckets (or "
+            "set explicit admit/prompt bucket ladders) so the jit cache "
+            "stays bounded",
+            node=t.name,
+        )
+
+
 @rule("recompile-churn", Severity.WARN)
 def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
     """Shape-signature churn at jit boundaries: several distinct schemas

@@ -54,7 +54,8 @@ def register_model_def(name: str):
     return deco
 
 
-_ZOO_MODULES = ("lenet", "inception", "resnet", "bilstm", "widedeep")
+_ZOO_MODULES = ("lenet", "inception", "resnet", "bilstm", "widedeep",
+                "chartransformer")
 
 
 def get_model_def(architecture: str, **config) -> ModelDef:

@@ -1,7 +1,10 @@
 """Custom TPU kernels (pallas) for hot ops the XLA graph path can't fuse
 optimally — see /opt/skills/guides/pallas_guide.md conventions."""
 
-from flink_tensorflow_tpu.ops.flash_attention import flash_attention
+from flink_tensorflow_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_decode,
+)
 from flink_tensorflow_tpu.ops.preprocessing import (
     central_crop,
     inception_normalize,
@@ -12,6 +15,7 @@ from flink_tensorflow_tpu.ops.preprocessing import (
 
 __all__ = [
     "flash_attention",
+    "flash_attention_decode",
     "central_crop",
     "inception_normalize",
     "mnist_normalize",

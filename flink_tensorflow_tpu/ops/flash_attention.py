@@ -68,6 +68,70 @@ def flash_attention(
     return out
 
 
+def flash_attention_decode(
+    q, k, v,
+    lengths=None,
+    *,
+    return_lse: bool = False,
+):
+    """Single-step decode attention: ONE query per row over a cached
+    prefix — the serving plane's per-token hot path.
+
+    ``q``: ``[B, 1, H, D]`` (or ``[B, H, D]``), the current position's
+    query.  ``k``/``v``: ``[B, C, H, D]`` KV-cache blocks at (padded)
+    capacity ``C``.  ``lengths``: ``[B]`` int32 — the number of VALID
+    cached positions per row; positions ``>= lengths[b]`` are masked
+    out (cache slack never attends).  Returns ``[B, 1, H, D]`` in q's
+    dtype (squeezed back to ``[B, H, D]`` for 3-D q), plus the per-row
+    log-sum-exp ``[B, H, 1]`` f32 when ``return_lse=True`` — the same
+    residual contract as :func:`flash_attention`, so ring-style callers
+    (parallel/ring_attention.ring_decode_attention) fold shard outputs
+    with ``_combine_blocks`` unchanged.
+
+    Deliberately NOT a pallas grid: a 1-row q block leaves the MXU
+    >99% idle, and the score row is ``[B, H, C]`` — O(C), not O(T^2) —
+    so the online-softmax streaming that justifies the kernel buys
+    nothing here.  A fused jnp einsum pair (f32 accumulation, masked
+    softmax) is the fastest shape on TPU and CPU alike, and it jits
+    into the decode step's single executable alongside the cache
+    update.  A fully-masked row (``lengths == 0``) returns zeros with
+    ``lse = -inf`` instead of NaN (inactive pool slots hit this).
+    """
+    import jax.numpy as jnp
+
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, tq, h, d = q.shape
+    if tq != 1:
+        raise ValueError(
+            f"flash_attention_decode takes exactly one query step, got T={tq}; "
+            "use flash_attention for prefill"
+        )
+    c = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale  # [B,H,1,C]
+    if lengths is not None:
+        valid = jnp.arange(c)[None, None, None, :] < lengths[:, None, None, None]
+        s = jnp.where(valid, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                      # [B,H,1]
+    safe_m = jnp.where(jnp.isinf(m), 0.0, m)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isinf(s), 0.0, p)
+    l = jnp.sum(p, axis=-1)                      # [B,H,1]
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = (out / denom.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    if squeeze:
+        out = out[:, 0]
+    if return_lse:
+        lse = jnp.where(l == 0.0, -jnp.inf, safe_m + jnp.log(denom))
+        return out, lse
+    return out
+
+
 def _tileable_block(t: int, pref: int) -> int:
     """Largest TPU-tileable block for a dim of size ``t``: Mosaic needs
     the block's sublane dim divisible by 8 OR equal to the whole array
@@ -86,13 +150,11 @@ def _tileable_block(t: int, pref: int) -> int:
 
 def _vma(*xs):
     """Union of the operands' varying-mesh-axes sets — required on pallas
-    out_shapes when the kernel runs inside shard_map (check_vma=True)."""
-    import jax
+    out_shapes when the kernel runs inside shard_map (check_vma=True).
+    Empty on jax versions without vma tracking (utils/jaxcompat)."""
+    from flink_tensorflow_tpu.utils.jaxcompat import varying_axes
 
-    out: frozenset = frozenset()
-    for x in xs:
-        out = out | getattr(jax.typeof(x), "vma", frozenset())
-    return out
+    return varying_axes(*xs)
 
 
 def _flash_bh(q, k, v, *, causal, block_q, block_k, interpret):
@@ -117,6 +179,11 @@ def _build_flash_call(bh, t, tk, d, dtype_str, causal, block_q, block_k,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from flink_tensorflow_tpu.utils.jaxcompat import (
+        shape_dtype_struct,
+        tpu_compiler_params,
+    )
 
     dtype = jnp.dtype(dtype_str)
     nq, nk = t // block_q, tk // block_k
@@ -195,8 +262,8 @@ def _build_flash_call(bh, t, tk, d, dtype_str, causal, block_q, block_k,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32, vma=vma),
+            shape_dtype_struct((bh, t, d), dtype, vma),
+            shape_dtype_struct((bh, t, 1), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -207,7 +274,7 @@ def _build_flash_call(bh, t, tk, d, dtype_str, causal, block_q, block_k,
         # j==0 per (bh, qi)): declaring them parallel lets Mosaic
         # megacore-partition the grid on v4/v5p; only the K sweep is
         # order-dependent (online-softmax carry).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,

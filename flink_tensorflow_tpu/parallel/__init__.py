@@ -36,10 +36,12 @@ from flink_tensorflow_tpu.parallel.ring_attention import (
     full_attention,
     ring_attention,
     ring_attention_sharded,
+    ring_decode_attention,
 )
 from flink_tensorflow_tpu.parallel.ulysses import (
     ulysses_attention,
     ulysses_attention_sharded,
+    ulysses_decode_attention,
 )
 
 __all__ = [
@@ -64,8 +66,10 @@ __all__ = [
     "replicated",
     "ring_attention",
     "ring_attention_sharded",
+    "ring_decode_attention",
     "shard_batch",
     "spans_processes",
     "ulysses_attention",
     "ulysses_attention_sharded",
+    "ulysses_decode_attention",
 ]

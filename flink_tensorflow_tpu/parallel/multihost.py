@@ -61,7 +61,13 @@ def initialize(
     num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
     process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
 
-    already = jax.distributed.is_initialized()
+    # jax.distributed.is_initialized() is newer than 0.4.x; older jax
+    # exposes the same fact through global_state.client.
+    if hasattr(jax.distributed, "is_initialized"):
+        already = jax.distributed.is_initialized()
+    else:
+        state = getattr(jax.distributed, "global_state", None)
+        already = getattr(state, "client", None) is not None
     if not already and (coordinator_address is not None or num_processes not in (None, 1)):
         # The platform may be pinned via env var OR jax.config (the axon
         # plugin workaround uses the latter); honor both.

@@ -21,8 +21,11 @@ from __future__ import annotations
 
 import functools
 import math
+import typing
 
 from flink_tensorflow_tpu.parallel.mesh import SEQ_AXIS
+from flink_tensorflow_tpu.utils.jaxcompat import axis_size as compat_axis_size
+from flink_tensorflow_tpu.utils.jaxcompat import shard_map as compat_shard_map
 
 
 def _block_attention(q, k, v, m, l, o, mask):
@@ -71,7 +74,8 @@ def _combine_blocks(o_acc, lse_acc, o_blk, lse_blk):
 
 
 def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
-                           causal: bool = False, impl: str = "flash"):
+                           causal: bool = False, impl: str = "flash",
+                           axis_size: typing.Optional[int] = None):
     """Ring attention body — call INSIDE ``shard_map`` over ``axis_name``.
 
     q/k/v: the local shard ``[B, T_local, H, D]``.  Returns the local
@@ -83,14 +87,15 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     online-softmax path (golden baseline / debugging).
     """
     if impl == "flash":
-        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal)
+        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
+                           axis_size=axis_size)
     if impl != "einsum":
         raise ValueError(f"impl must be 'flash' or 'einsum', got {impl!r}")
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name, axis_size)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     qf = q.astype(jnp.float32)
@@ -131,7 +136,8 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     return out.astype(q.dtype)
 
 
-def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
+def _ring_flash(q, k, v, *, axis_name: str, causal: bool,
+                axis_size: typing.Optional[int] = None):
     """Flash-kernel ring body: each K/V block runs through the pallas
     kernel (MXU matmuls, O(block) VMEM), blocks merge via lse residuals.
 
@@ -146,7 +152,7 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
 
     from flink_tensorflow_tpu.ops.flash_attention import flash_attention
 
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name, axis_size)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -209,8 +215,9 @@ def ring_attention(mesh, q, k, v, *, causal: bool = False, impl: str = "flash"):
     # Batch rides the data axis when the mesh has one (dp x sp composes).
     batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
     spec = P(batch_axis, SEQ_AXIS, None, None)
-    fn = jax.shard_map(
-        functools.partial(ring_attention_sharded, causal=causal, impl=impl),
+    fn = compat_shard_map(
+        functools.partial(ring_attention_sharded, causal=causal, impl=impl,
+                          axis_size=dict(mesh.shape)[SEQ_AXIS]),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -222,6 +229,67 @@ def ring_attention(mesh, q, k, v, *, causal: bool = False, impl: str = "flash"):
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return jax.jit(fn)(q, k, v)
+
+
+def ring_decode_attention(mesh, q, k, v, lengths, *, axis_name: str = SEQ_AXIS):
+    """Decode-step attention with the KV cache sharded over ``seq``.
+
+    The serving counterpart of :func:`ring_attention`: at decode time
+    there is ONE query per row, so instead of rotating K/V blocks n-1
+    times, every device computes :func:`flash_attention_decode` over its
+    LOCAL cache shard and the per-shard ``(o, lse)`` pairs fold with the
+    same ``_combine_blocks`` recombination the ring uses — one
+    ``all_gather`` of a ``[B, 1, H, D]`` output (tiny next to the cache)
+    replaces the whole K/V ring.
+
+    ``q``: global ``[B, 1, H, D]``; ``k``/``v``: global ``[B, C, H, D]``
+    cache at capacity ``C`` (``C`` divisible by the seq-axis size);
+    ``lengths``: global ``[B]`` valid cache lengths.  Output: global
+    ``[B, 1, H, D]`` replicated over the axis.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flink_tensorflow_tpu.ops.flash_attention import flash_attention_decode
+
+    n = dict(mesh.shape)[axis_name]
+    c = k.shape[1]
+    if c % n:
+        raise ValueError(f"cache capacity {c} must divide the {axis_name} "
+                         f"axis size {n}")
+    c_local = c // n
+
+    def body(q_, k_, v_, lengths_):
+        i = lax.axis_index(axis_name)
+        local_valid = jnp.clip(lengths_ - i * c_local, 0, c_local)
+        o, lse = flash_attention_decode(q_, k_, v_, local_valid,
+                                        return_lse=True)
+        # Fold every shard's (o, lse): gather the tiny outputs, combine
+        # sequentially (n is a static python int — unrolled, no carry).
+        os = lax.all_gather(o.astype(jnp.float32), axis_name)   # [n,B,1,H,D]
+        lses = lax.all_gather(lse, axis_name)                   # [n,B,H,1]
+        o_acc, lse_acc = os[0], lses[0]
+        for j in range(1, n):
+            o_acc, lse_acc = _combine_blocks(o_acc, lse_acc, os[j], lses[j])
+        return o_acc.astype(q_.dtype)
+
+    kv_spec = P(None, axis_name, None, None)
+    rep = P(None, None, None, None)
+    fn = compat_shard_map(
+        body, mesh=mesh,
+        in_specs=(rep, kv_spec, kv_spec, P(None)),
+        out_specs=rep,
+        # The fold of all-gathered (o, lse) pairs IS replicated, but the
+        # replication checker can't infer that through the combine math.
+        check_vma=False,
+    )
+    q = jax.device_put(q, NamedSharding(mesh, rep))
+    k = jax.device_put(k, NamedSharding(mesh, kv_spec))
+    v = jax.device_put(v, NamedSharding(mesh, kv_spec))
+    lengths = jax.device_put(lengths, NamedSharding(mesh, P(None)))
+    return jax.jit(fn)(q, k, v, lengths)
 
 
 def full_attention(q, k, v, *, causal: bool = False):

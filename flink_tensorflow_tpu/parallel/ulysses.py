@@ -23,12 +23,16 @@ compose with a ``data`` axis for dp x sp meshes.
 from __future__ import annotations
 
 import functools
+import typing
 
 from flink_tensorflow_tpu.parallel.mesh import SEQ_AXIS
+from flink_tensorflow_tpu.utils.jaxcompat import axis_size as compat_axis_size
+from flink_tensorflow_tpu.utils.jaxcompat import shard_map as compat_shard_map
 
 
 def ulysses_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
-                              causal: bool = False, impl: str = "flash"):
+                              causal: bool = False, impl: str = "flash",
+                              axis_size: typing.Optional[int] = None):
     """Ulysses body — call INSIDE ``shard_map`` over ``axis_name``.
 
     q/k/v: the local shard ``[B, T_local, H, D]`` with ``H`` divisible by
@@ -36,7 +40,7 @@ def ulysses_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     """
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name, axis_size)
     b, t, h, d = q.shape
     if h % n:
         raise ValueError(
@@ -68,6 +72,52 @@ def ulysses_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     return heads_to_seq(out_h.astype(q.dtype))
 
 
+def ulysses_decode_attention(mesh, q, k, v, lengths, *,
+                             axis_name: str = SEQ_AXIS):
+    """Decode-step attention with the KV cache sharded over HEADS.
+
+    The Ulysses inference layout: at decode time the query is one
+    position, so re-sharding sequence<->heads with all-to-alls
+    degenerates (there is no sequence to split).  Instead the cache is
+    stored head-sharded ``[B, C, H/n, D]`` across the ``seq`` axis and
+    every device computes :func:`flash_attention_decode` over its own
+    heads — embarrassingly parallel, zero collectives per step.  Same
+    ``H % n == 0`` constraint as prefill Ulysses.
+
+    ``q``: global ``[B, 1, H, D]``; ``k``/``v``: global ``[B, C, H, D]``;
+    ``lengths``: global ``[B]``.  Output: global ``[B, 1, H, D]``
+    head-sharded (one ``device_get`` materializes it).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flink_tensorflow_tpu.ops.flash_attention import flash_attention_decode
+
+    n = dict(mesh.shape)[axis_name]
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses decode needs heads ({h}) divisible by the "
+            f"{axis_name}-axis size ({n}); use ring_decode_attention for "
+            "head counts that don't split"
+        )
+
+    def body(q_, k_, v_, lengths_):
+        return flash_attention_decode(q_, k_, v_, lengths_)
+
+    head_spec = P(None, None, axis_name, None)
+    fn = compat_shard_map(
+        body, mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec, P(None)),
+        out_specs=head_spec,
+    )
+    q = jax.device_put(q, NamedSharding(mesh, head_spec))
+    k = jax.device_put(k, NamedSharding(mesh, head_spec))
+    v = jax.device_put(v, NamedSharding(mesh, head_spec))
+    lengths = jax.device_put(lengths, NamedSharding(mesh, P(None)))
+    return jax.jit(fn)(q, k, v, lengths)
+
+
 def ulysses_attention(mesh, q, k, v, *, causal: bool = False, impl: str = "flash"):
     """User-facing Ulysses attention over a mesh with a ``seq`` axis.
 
@@ -82,8 +132,9 @@ def ulysses_attention(mesh, q, k, v, *, causal: bool = False, impl: str = "flash
     # Batch rides the data axis when the mesh has one (dp x sp composes).
     batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
     spec = P(batch_axis, SEQ_AXIS, None, None)
-    fn = jax.shard_map(
-        functools.partial(ulysses_attention_sharded, causal=causal, impl=impl),
+    fn = compat_shard_map(
+        functools.partial(ulysses_attention_sharded, causal=causal, impl=impl,
+                          axis_size=dict(mesh.shape)[SEQ_AXIS]),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

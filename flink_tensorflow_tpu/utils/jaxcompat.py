@@ -1,0 +1,80 @@
+"""Version tolerance for the handful of jax APIs that moved underneath us.
+
+The kernel and parallelism layers were written against the current jax
+surface (``jax.typeof(...).vma``, ``ShapeDtypeStruct(vma=...)``,
+``pltpu.CompilerParams``, ``jax.shard_map(check_vma=...)``); the
+container images this repo actually runs on pin jax 0.4.x, where none of
+those names exist yet (``vma`` tracking isn't a concept, shard_map lives
+in ``jax.experimental`` and spells the check ``check_rep``).  Every
+call site resolves through here so the SAME kernel code lowers on both
+surfaces instead of failing at import/trace time on the older one.
+Feature-probed once at import — no version string parsing.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+#: Whether this jax tracks varying mesh axes (vma) on avals.
+HAS_VMA = hasattr(_jax, "typeof")
+
+
+def varying_axes(*xs) -> frozenset:
+    """Union of the operands' varying-mesh-axes sets (empty set on jax
+    versions without vma tracking — shard_map there validates with
+    ``check_rep`` instead, so nothing is lost)."""
+    if not HAS_VMA:
+        return frozenset()
+    out: frozenset = frozenset()
+    for x in xs:
+        out = out | getattr(_jax.typeof(x), "vma", frozenset())
+    return out
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` with ``vma`` attached only where the
+    constructor knows the keyword."""
+    if HAS_VMA:
+        return _jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return _jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (current) / ``pltpu.TPUCompilerParams``
+    (0.4.x) — same fields, renamed class."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` (current) / ``jax.experimental.shard_map``
+    (0.4.x, where the replication check is spelled ``check_rep``)."""
+    fn = getattr(_jax, "shard_map", None)
+    if fn is not None:
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
+def axis_size(axis_name: str, static_size=None) -> int:
+    """Static mesh-axis size inside a shard_map body.  Current jax has
+    ``lax.axis_size``; 0.4.x has no static accessor at all, so callers
+    that know their mesh thread the size through ``static_size`` (the
+    in-repo wrappers do) and only truly axis-agnostic bodies require
+    the modern API."""
+    if static_size is not None:
+        return static_size
+    from jax import lax
+
+    fn = getattr(lax, "axis_size", None)
+    if fn is None:
+        raise NotImplementedError(
+            "this jax version has no static lax.axis_size — pass the "
+            "axis size explicitly (axis_size=mesh.shape[axis])"
+        )
+    return fn(axis_name)

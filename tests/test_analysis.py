@@ -453,6 +453,100 @@ class _CountWindowFn(fn.WindowFunction):
         out.collect(len(elements))
 
 
+class TestServingLints:
+    """serving-unkeyed-input / serving-recompile-churn matrix (ISSUE 10)."""
+
+    @staticmethod
+    def _model():
+        import jax
+
+        from flink_tensorflow_tpu.models import get_model_def
+
+        mdef = get_model_def("char_transformer", vocab_size=32, embed_dim=32,
+                             num_heads=2, num_layers=1, capacity=32)
+        return mdef.to_model(mdef.init_params(jax.random.PRNGKey(0)))
+
+    @staticmethod
+    def _requests():
+        from flink_tensorflow_tpu.serving import GenerateRequest
+
+        return [GenerateRequest(session_id="a",
+                                prompt=np.ones((4,), np.int32))]
+
+    def test_clean_keyed_serving_plan(self):
+        from flink_tensorflow_tpu.serving import ServingConfig, continuous_batching
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        continuous_batching(
+            env.from_collection(self._requests())
+            .key_by(lambda r: r.session_id),
+            self._model(), config=ServingConfig(capacity=32),
+        ).sink_to_list()
+        diags = analyze(env.graph, config=env.config)
+        assert not by_rule(diags, "serving-unkeyed-input")
+        assert not by_rule(diags, "serving-recompile-churn")
+
+    def test_unkeyed_edge_is_error(self):
+        from flink_tensorflow_tpu.serving import (
+            ContinuousBatchingOperator,
+            ServingConfig,
+        )
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        src = env.from_collection(self._requests())
+        model = self._model()
+        # Hand-built plan bypassing continuous_batching: rebalance edge,
+        # no key selector — both findings fire.
+        env.graph.add(
+            "serve",
+            lambda: ContinuousBatchingOperator(
+                "serve", model, ServingConfig(capacity=32)),
+            1,
+            inputs=[Edge(src.transformation, RebalancePartitioner())],
+        )
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "serving-unkeyed-input")
+        assert len(diags) == 2
+        assert all(d.severity == Severity.ERROR for d in diags)
+        assert any("Rebalance" in d.message for d in diags)
+        assert any("key selector" in d.message for d in diags)
+
+    def test_disabled_padding_buckets_warn(self):
+        from flink_tensorflow_tpu.serving import ServingConfig, continuous_batching
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        continuous_batching(
+            env.from_collection(self._requests())
+            .key_by(lambda r: r.session_id),
+            self._model(),
+            config=ServingConfig(capacity=32, padding_buckets=False),
+        ).sink_to_list()
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "serving-recompile-churn")
+        assert len(diags) == 1 and diags[0].severity == Severity.WARN
+        assert "padding_buckets" in diags[0].message
+
+    def test_fixed_window_baseline_also_covered(self):
+        from flink_tensorflow_tpu.serving import (
+            FixedWindowGenerateFunction,
+            ServingConfig,
+        )
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        (
+            env.from_collection(self._requests())
+            .count_window(4)
+            .apply(FixedWindowGenerateFunction(
+                self._model(),
+                ServingConfig(capacity=32, padding_buckets=False)),
+                name="fixed")
+            .sink_to_list()
+        )
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "serving-recompile-churn")
+        assert len(diags) == 1 and diags[0].node == "fixed"
+
+
 class TestWatermarkLints:
     """ISSUE-2 satellite: the deferred watermark lints from ROADMAP."""
 

@@ -40,6 +40,14 @@ class TestExampleJobs:
         out = inception_inference.main(["--smoke", "--cpu"])
         assert out["records"] == 16 and len(out["sample_labels"]) == 5
 
+    def test_llm_serving_pipeline(self):
+        from examples import llm_serving_pipeline
+
+        out = llm_serving_pipeline.main(["--smoke", "--cpu"])
+        assert out["sessions"] == 8
+        assert out["tokens"] == 8 * 8  # every session ran to max_new
+        assert out["all_sessions_completed"]
+
     def test_split_source_pipeline(self):
         from examples import split_source_pipeline
 

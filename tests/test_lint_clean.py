@@ -47,7 +47,10 @@ def test_ruff_clean():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-@pytest.mark.parametrize("pipeline", EXAMPLES)
+@pytest.mark.parametrize("pipeline", EXAMPLES + [
+    "examples/split_source_pipeline.py",
+    "examples/llm_serving_pipeline.py",
+])
 def test_examples_plan_has_no_error_diagnostics(pipeline):
     from flink_tensorflow_tpu.analysis import (
         Severity,
@@ -62,7 +65,10 @@ def test_examples_plan_has_no_error_diagnostics(pipeline):
     assert errors == [], format_diagnostics(diags)
 
 
-@pytest.mark.parametrize("pipeline", EXAMPLES + ["examples/split_source_pipeline.py"])
+@pytest.mark.parametrize("pipeline", EXAMPLES + [
+    "examples/split_source_pipeline.py",
+    "examples/llm_serving_pipeline.py",
+])
 def test_examples_have_zero_purity_lint_errors(pipeline):
     """Tier-1 replay-purity gate (PR 5): no example's USER code may read
     the wall clock, draw from a process-global RNG, mutate globals, or

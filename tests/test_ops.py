@@ -6,7 +6,7 @@ import pytest
 
 import jax.numpy as jnp
 
-from flink_tensorflow_tpu.ops import flash_attention
+from flink_tensorflow_tpu.ops import flash_attention, flash_attention_decode
 from flink_tensorflow_tpu.parallel import full_attention
 
 
@@ -89,6 +89,155 @@ class TestFlashAttention:
         _, lse = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                                  causal=True, return_lse=True)
         assert np.all(np.isfinite(np.asarray(lse)))
+
+
+class TestFlashAttentionDecode:
+    """Single-query decode step (the serving plane's per-token path):
+    must equal the full-prefix kernel at the last valid position."""
+
+    def test_single_step_equals_full_prefix(self):
+        rng = np.random.RandomState(0)
+        b, c, h, d = 3, 32, 2, 16
+        lengths = np.array([32, 20, 7], np.int32)
+        k = rng.randn(b, c, h, d).astype(np.float32)
+        v = rng.randn(b, c, h, d).astype(np.float32)
+        q1 = rng.randn(b, 1, h, d).astype(np.float32)
+        got = flash_attention_decode(jnp.asarray(q1), jnp.asarray(k),
+                                     jnp.asarray(v), jnp.asarray(lengths))
+        # Reference: per row, full (non-causal) attention of the single
+        # query over exactly the valid prefix.
+        for i in range(b):
+            n = lengths[i]
+            want = full_attention(jnp.asarray(q1[i:i + 1]),
+                                  jnp.asarray(k[i:i + 1, :n]),
+                                  jnp.asarray(v[i:i + 1, :n]))
+            np.testing.assert_allclose(np.asarray(got[i]),
+                                       np.asarray(want[0]), atol=1e-5)
+
+    def test_matches_causal_prefill_last_position(self):
+        """Decode over a cache built by causal prefill == the causal
+        kernel's output at the final position — the incremental/full
+        consistency the KV cache relies on."""
+        rng = np.random.RandomState(1)
+        b, t, h, d = 2, 24, 2, 8
+        q = rng.randn(b, t, h, d).astype(np.float32)
+        k = rng.randn(b, t, h, d).astype(np.float32)
+        v = rng.randn(b, t, h, d).astype(np.float32)
+        full = flash_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True,
+                               block_q=8, block_k=8)
+        step = flash_attention_decode(
+            jnp.asarray(q[:, -1:]), jnp.asarray(k), jnp.asarray(v),
+            jnp.full((b,), t, np.int32))
+        np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                   np.asarray(full[:, -1]), atol=1e-5)
+
+    def test_squeezed_3d_query_and_zero_length_rows(self):
+        rng = np.random.RandomState(2)
+        b, c, h, d = 2, 16, 2, 8
+        q = rng.randn(b, h, d).astype(np.float32)
+        k = rng.randn(b, c, h, d).astype(np.float32)
+        v = rng.randn(b, c, h, d).astype(np.float32)
+        lengths = np.array([10, 0], np.int32)  # row 1: inactive pool slot
+        out, lse = flash_attention_decode(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(lengths), return_lse=True)
+        assert out.shape == (b, h, d)
+        assert np.all(np.isfinite(np.asarray(out)))
+        assert np.all(np.asarray(out)[1] == 0.0)       # masked row -> zeros
+        assert np.all(np.isneginf(np.asarray(lse)[1]))  # lse residual -inf
+
+    def test_lse_recombines_split_cache_ring_style(self):
+        """Two half-cache decode calls fold into the full answer via the
+        ring's _combine_blocks — the sharded-decode contract."""
+        from flink_tensorflow_tpu.parallel.ring_attention import _combine_blocks
+
+        rng = np.random.RandomState(3)
+        b, c, h, d = 2, 32, 2, 8
+        q = rng.randn(b, 1, h, d).astype(np.float32)
+        k = rng.randn(b, c, h, d).astype(np.float32)
+        v = rng.randn(b, c, h, d).astype(np.float32)
+        lengths = np.array([28, 11], np.int32)
+        want = flash_attention_decode(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), jnp.asarray(lengths))
+        half = c // 2
+        lo = np.clip(lengths, 0, half).astype(np.int32)
+        hi = np.clip(lengths - half, 0, half).astype(np.int32)
+        o1, l1 = flash_attention_decode(jnp.asarray(q), jnp.asarray(k[:, :half]),
+                                        jnp.asarray(v[:, :half]),
+                                        jnp.asarray(lo), return_lse=True)
+        o2, l2 = flash_attention_decode(jnp.asarray(q), jnp.asarray(k[:, half:]),
+                                        jnp.asarray(v[:, half:]),
+                                        jnp.asarray(hi), return_lse=True)
+        # _combine_blocks wants lse as [B, H, T]; decode returns [B, H, 1].
+        got, _ = _combine_blocks(o1.astype(jnp.float32), l1,
+                                 o2.astype(jnp.float32), l2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+class TestShardedDecode:
+    """Ring/Ulysses decode paths, smoke-tested on the virtual CPU mesh."""
+
+    def _case(self, seed=5, b=2, c=32, h=4, d=8):
+        rng = np.random.RandomState(seed)
+        q = rng.randn(b, 1, h, d).astype(np.float32)
+        k = rng.randn(b, c, h, d).astype(np.float32)
+        v = rng.randn(b, c, h, d).astype(np.float32)
+        lengths = np.array([c, 13], np.int32)[:b]
+        want = flash_attention_decode(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), jnp.asarray(lengths))
+        return q, k, v, lengths, want
+
+    def test_ring_decode_matches_unsharded(self):
+        from flink_tensorflow_tpu.parallel import make_mesh, ring_decode_attention
+
+        import jax
+
+        q, k, v, lengths, want = self._case()
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        got = ring_decode_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), jnp.asarray(lengths))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_ulysses_decode_matches_unsharded(self):
+        from flink_tensorflow_tpu.parallel import (
+            make_mesh,
+            ulysses_decode_attention,
+        )
+
+        import jax
+
+        q, k, v, lengths, want = self._case()
+        # Shards the 4 heads over a 4-device slice of the virtual mesh.
+        mesh = make_mesh({"seq": 4}, devices=jax.devices()[:4])
+        got = ulysses_decode_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v), jnp.asarray(lengths))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_ulysses_decode_indivisible_heads_rejected(self):
+        from flink_tensorflow_tpu.parallel import (
+            make_mesh,
+            ulysses_decode_attention,
+        )
+
+        mesh = make_mesh({"seq": 8})
+        q = jnp.zeros((1, 1, 6, 8))
+        kv = jnp.zeros((1, 16, 6, 8))
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_decode_attention(mesh, q, kv, kv,
+                                     jnp.full((1,), 16, jnp.int32))
+
+    def test_ring_decode_indivisible_capacity_rejected(self):
+        from flink_tensorflow_tpu.parallel import make_mesh, ring_decode_attention
+
+        mesh = make_mesh({"seq": 8})
+        q = jnp.zeros((1, 1, 4, 8))
+        kv = jnp.zeros((1, 30, 4, 8))  # 30 % 8 != 0
+        with pytest.raises(ValueError, match="divide"):
+            ring_decode_attention(mesh, q, kv, kv,
+                                  jnp.full((1,), 30, jnp.int32))
 
 
 class TestTileableBlocks:
