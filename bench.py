@@ -2778,6 +2778,227 @@ def bench_serving(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# workload 10: chaos soak — seeded faults under sustained load (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_stage_p50s(trace_path) -> dict:
+    """Per-stage p50 (ms) from one exported Chrome trace — the compact
+    before/after attribution rows (align / snapshot / checkpoint /
+    process are where recovery cost lands)."""
+    from flink_tensorflow_tpu.tracing.attribution import (
+        attribution,
+        events_from_chrome,
+    )
+
+    try:
+        with open(trace_path) as f:
+            events = events_from_chrome(json.load(f))
+    except (OSError, ValueError):
+        return {}
+    merged: dict = {}
+    for rows in attribution(events).values():
+        for stage, row in rows.items():
+            if stage not in ("align", "snapshot", "checkpoint", "process",
+                             "emit"):
+                continue
+            agg = merged.setdefault(stage, {"count": 0, "total_ms": 0.0,
+                                            "p50s": []})
+            agg["count"] += row["count"]
+            agg["total_ms"] += row["total_ms"]
+            agg["p50s"].append(row["p50_ms"])
+    return {
+        stage: {"count": agg["count"],
+                "total_ms": round(agg["total_ms"], 3),
+                "p50_ms": round(float(np.median(agg["p50s"])), 4)}
+        for stage, agg in merged.items()
+    }
+
+
+def bench_chaos(args) -> dict:
+    """Chaos soak (ISSUE 11): the SAME keyed stateful job through a 2PC
+    sink runs twice under sustained throttled load — once clean, once
+    under a seeded fault schedule (subtask kill -> exponential-backoff
+    restart from the last count-based checkpoint; checkpoint-store write
+    failure -> declined checkpoint; stall -> deadline abort) with the
+    concurrency sanitizer ON — plus a severed RemoteSink pipe leg
+    exercising the reconnect plane.  The oracle is byte-identity:
+    ``read_committed()`` of the chaos arm must equal the clean arm's
+    exactly (sorted serialized records), i.e. records_lost == 0 through
+    every fault.  Books recovery wall time, abort counts, reconnects,
+    and the clean-vs-chaos per-stage trace attribution."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment
+    from flink_tensorflow_tpu.core import functions as fn
+    from flink_tensorflow_tpu.core.environment import RestartStrategy
+    from flink_tensorflow_tpu.core.state import StateDescriptor
+    from flink_tensorflow_tpu.io.files import (
+        ExactlyOnceRecordFileSink,
+        read_committed,
+    )
+    from flink_tensorflow_tpu.tensors import TensorValue
+    from flink_tensorflow_tpu.tensors.serde import encode_record
+
+    n = args.records or (400 if args.smoke else 4000)
+    every = max(20, n // 20)
+    throttle = 0.0008 if args.smoke else 0.0005
+    keys = 8
+    state = StateDescriptor("sum", default_factory=lambda: 0)
+
+    class KeyedSum(fn.ProcessFunction):
+        def process_element(self, value, ctx, out):
+            s = ctx.state(state)
+            cur = s.value() + int(value)
+            s.update(cur)
+            out.collect(TensorValue(
+                {"v": np.int64(cur)},
+                {"key": int(ctx.current_key), "i": int(value)},
+            ))
+
+    tmp = tempfile.mkdtemp(prefix="bench_chaos_")
+
+    def run_arm(tag, faults=None, restart=None, timeout_s=None):
+        out = os.path.join(tmp, f"out-{tag}")
+        trace_path = os.path.join(tmp, f"trace-{tag}.json")
+        env = StreamExecutionEnvironment(parallelism=2)
+        env.enable_checkpointing(os.path.join(tmp, f"chk-{tag}"),
+                                 every_n_records=every)
+        if timeout_s:
+            env.configure(checkpoint=dataclasses.replace(
+                env.config.checkpoint, timeout_s=timeout_s))
+        env.configure(sanitize=True, trace=True, trace_path=trace_path,
+                      trace_sample_rate=0.25)
+        if faults:
+            env.configure(faults=faults)
+        env.source_throttle_s = throttle
+        (
+            env.from_collection(list(range(n)), name="src")
+            .key_by(lambda x: x % keys)
+            .process(KeyedSum(), name="count", parallelism=2)
+            .add_sink(ExactlyOnceRecordFileSink(out), name="sink",
+                      parallelism=1)
+        )
+        t0 = time.monotonic()
+        env.execute(f"chaos-{tag}", timeout=600, restart_strategy=restart)
+        wall = time.monotonic() - t0
+        rep = env.metric_registry.report()
+        digest = sorted(bytes(encode_record(r)) for r in read_committed(out))
+        return {
+            "wall_s": round(wall, 3),
+            "records_per_s": round(n / wall, 1),
+            "records_committed": len(digest),
+            "restarts": rep.get("recovery.restarts_total", 0),
+            "recovery_s": round(
+                (rep.get("recovery.recovery_duration_s") or {}).get(
+                    "total_s", 0.0), 4),
+            "checkpoints_aborted": rep.get("recovery.checkpoints_aborted", 0),
+            "faults_fired": {
+                k.split(".", 1)[1]: v["count"]
+                for k, v in rep.items()
+                if k.startswith("faults.") and isinstance(v, dict)
+                and v.get("count")
+            },
+            "sanitizer_violations": rep.get("sanitizer.violations", 0),
+            "stage_p50s": _chaos_stage_p50s(trace_path),
+        }, digest
+
+    clean, clean_digest = run_arm("clean")
+    # Seeded schedule: kill the source subtask a third of the way in
+    # (epoch 0 only — the restarted run replays clean), fail checkpoint
+    # 2's store write, and stall the keyed subtask past a tightened
+    # checkpoint deadline on the restarted epoch.
+    schedule = (
+        f"kill:src.0@{n // 3};"
+        "store_fail@2;"
+        f"stall:count.0@{max(1, n // (2 * keys) // 2)}~0.8#1"
+    )
+    chaos, chaos_digest = run_arm(
+        "chaos", faults=schedule,
+        restart=RestartStrategy(max_restarts=3, delay_s=0.05,
+                                backoff_multiplier=2.0, max_delay_s=1.0,
+                                jitter=0.1),
+        timeout_s=0.3,
+    )
+    records_lost = len(clean_digest) - len(chaos_digest)
+    byte_identical = clean_digest == chaos_digest
+
+    # Sever leg: RemoteSink -> RemoteSource pipe, edge cut mid-stream;
+    # the sink's backoff reconnect + the source's held fan-in slot must
+    # deliver byte-identically with exactly one reconnect.
+    def run_pipe(tag, faults=None):
+        from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+
+        out = os.path.join(tmp, f"pipe-{tag}")
+        source = RemoteSource(bind="127.0.0.1")
+        errors = []
+
+        def consume():
+            try:
+                cenv = StreamExecutionEnvironment(parallelism=1)
+                cenv.from_source(source, name="rsrc").add_sink(
+                    ExactlyOnceRecordFileSink(out), name="csink")
+                cenv.execute(f"pipe-consumer-{tag}", timeout=300)
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        env = StreamExecutionEnvironment(parallelism=1)
+        if faults:
+            env.configure(faults=faults)
+        (
+            env.from_collection(list(range(n // 4)), name="psrc")
+            .map(lambda v: TensorValue({"v": np.int64(v)}, {"i": int(v)}),
+                 name="tv")
+            .add_sink(RemoteSink("127.0.0.1", source.port,
+                                 flush_bytes=4096, flush_ms=1.0),
+                      name="rsink")
+        )
+        t0 = time.monotonic()
+        env.execute(f"pipe-producer-{tag}", timeout=300)
+        t.join(300)
+        rep = env.metric_registry.report()
+        digest = sorted(bytes(encode_record(r)) for r in read_committed(out))
+        return {
+            "wall_s": round(time.monotonic() - t0, 3),
+            "records_committed": len(digest),
+            "reconnects": rep.get("rsink.0.reconnects", 0),
+            "errors": errors,
+        }, digest
+
+    # Sever at the 5th coalesced frame — early enough to exist at every
+    # workload size (the 4KB flush threshold packs ~56 records/frame).
+    pipe_clean, pipe_clean_digest = run_pipe("clean")
+    pipe_sever, pipe_sever_digest = run_pipe(
+        "sever", faults="sever:rsink.0@5")
+
+    return {
+        "metric": "chaos_soak_recovery_s",
+        "value": chaos["recovery_s"],
+        "unit": "s",
+        "vs_baseline": None,
+        "records": n,
+        "checkpoint_every_n": every,
+        "records_lost": records_lost,
+        "byte_identical": byte_identical,
+        "sever_byte_identical": pipe_sever_digest == pipe_clean_digest,
+        "sever_reconnects": pipe_sever["reconnects"],
+        "clean": clean,
+        "chaos": chaos,
+        "pipe_clean": pipe_clean,
+        "pipe_sever": pipe_sever,
+        "fault_schedule": schedule,
+        "baseline_note": (
+            "no reference counterpart: the reference inherits Flink's "
+            "failover but never measures it; the oracle here is "
+            "byte-identical read_committed() output vs the fault-free run"),
+    }
+
+
 WORKLOADS = {
     "inception": bench_inception,
     "mnist": bench_mnist,
@@ -2788,6 +3009,7 @@ WORKLOADS = {
     "deviceres": bench_deviceres,
     "shuffle": bench_shuffle,
     "serving": bench_serving,
+    "chaos": bench_chaos,
 }
 
 #: --workload aliases, resolved before dispatch ("all" never expands

@@ -28,12 +28,15 @@ from flink_tensorflow_tpu.version import __version__
 from flink_tensorflow_tpu.core.config import CheckpointConfig, JobConfig
 from flink_tensorflow_tpu.core.distributed import DistributedConfig
 from flink_tensorflow_tpu.core.environment import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core.faults import FaultPlan, FaultSpec
 from flink_tensorflow_tpu.core.stream import DataStream, KeyedStream, WindowedStream
 
 __all__ = [
     "__version__",
     "CheckpointConfig",
     "DistributedConfig",
+    "FaultPlan",
+    "FaultSpec",
     "JobConfig",
     "StreamExecutionEnvironment",
     "DataStream",

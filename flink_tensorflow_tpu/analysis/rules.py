@@ -669,6 +669,46 @@ def _remote_edge_buffer_timeout(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
+@rule("exactly-once-boundary", Severity.WARN)
+def _exactly_once_boundary(ctx: AnalysisContext, emit: Emit) -> None:
+    """Checkpointed plan ingesting through a NON-REPLAYABLE source: a
+    raw ``RemoteSource`` (or any source declaring ``replayable =
+    False``) is a live TCP stream — restart-from-checkpoint rewinds
+    every operator's state to the snapshot and replays sources from
+    their recorded offsets, but a network stream cannot be re-read, so
+    records consumed after the restored checkpoint are processed
+    at-least-once ... or lost outright if they were in flight
+    (documented in io/remote.py).  The exactly-once story stops at this
+    boundary no matter how transactional the sinks are.  Front the feed
+    with a durable write-ahead log — land it in frame files and ingest
+    via a replayable ``FileSplitSource`` — exactly as Flink treats raw
+    socket sources."""
+    cfg = ctx.config
+    if cfg is None:
+        return
+    checkpoint = getattr(cfg, "checkpoint", None)
+    if checkpoint is None or getattr(checkpoint, "dir", None) is None:
+        return  # no checkpoint/restart story claimed — nothing to break
+    for t in ctx.order:
+        if not t.is_source:
+            continue
+        op = ctx.operators.get(t.id)
+        for attr in ("function", "source"):
+            feed = getattr(op, attr, None)
+            if feed is not None and getattr(feed, "replayable", True) is False:
+                emit(
+                    f"source {t.name!r} ({type(feed).__name__}) is not "
+                    "replayable: after a restart-from-checkpoint its "
+                    "stream cannot be rewound, so delivery through this "
+                    "job is at-least-once (or lossy for in-flight "
+                    "records) regardless of sink transactionality — "
+                    "front it with a durable FileSplitSource-backed "
+                    "write-ahead log for end-to-end exactly-once",
+                    node=t.name,
+                )
+                break
+
+
 @rule("cohort-telemetry", Severity.WARN)
 def _cohort_telemetry(ctx: AnalysisContext, emit: Emit) -> None:
     """Distributed observability misconfiguration.  Two findings:

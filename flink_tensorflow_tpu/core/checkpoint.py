@@ -29,6 +29,9 @@ class _PendingCheckpoint:
         self.acks = 0
         self.done = threading.Event()
         self.failed = False
+        #: Set by the deadline sweeper: the checkpoint was declined
+        #: (missed checkpoint_timeout_s) and its state discarded.
+        self.aborted = False
         #: Count-based checkpoints have no trigger() caller waiting on
         #: them — persistence happens on completion, off the ack thread.
         self.source_initiated = source_initiated
@@ -81,6 +84,13 @@ class CheckpointCoordinator:
         self._last_size_bytes: typing.Optional[int] = None
         self.metrics.gauge("last_checkpoint_id", lambda: self._last_checkpoint_id)
         self.metrics.gauge("last_size_bytes", lambda: self._last_size_bytes)
+        #: Checkpoint ids declined at their deadline (the recovery
+        #: observability catalogue's ``checkpoints_aborted``): a stuck
+        #: barrier no longer wedges the job — the sweeper discards the
+        #: expired checkpoint and sources keep triggering later ones.
+        self.aborted_ids: typing.List[int] = []
+        registry.group("recovery").gauge(
+            "checkpoints_aborted", lambda: len(self.aborted_ids))
         #: Distributed record plane: barriers may originate at sources on
         #: PEER processes, so the first local sighting of checkpoint k is
         #: an ack from a worker subtask, not begin_source_checkpoint —
@@ -120,6 +130,15 @@ class CheckpointCoordinator:
         #: completed checkpoint is durable before the job reports done.
         self._persist_pool = None
         self._persist_futures: typing.List[typing.Any] = []
+        #: Deadline sweeper for SOURCE-INITIATED checkpoints (trigger()
+        #: callers enforce their own timeout): started lazily at the
+        #: first registration, it declines any pending checkpoint older
+        #: than ``executor.checkpoint_timeout_s`` — late acks land in
+        #: the void, subtasks drop the alignment, and the job keeps
+        #: flowing instead of wedging behind a barrier that never
+        #: arrives (dead subtask, severed edge, stalled operator).
+        self._abort_thread: typing.Optional[threading.Thread] = None
+        self._abort_stop = threading.Event()
 
     def resume_from(self, checkpoint_id: int) -> None:
         """Continue numbering after a restored checkpoint so new snapshots
@@ -196,6 +215,8 @@ class CheckpointCoordinator:
         if not pending.done.wait(timeout):
             with self._lock:
                 self._pending.pop(cid, None)
+                self.aborted_ids.append(cid)
+            self._announce_abort(cid, "trigger timeout")
             raise TimeoutError(f"checkpoint {cid} did not complete within {timeout}s")
         with self._lock:
             self._pending.pop(cid, None)
@@ -206,6 +227,9 @@ class CheckpointCoordinator:
         if self.checkpoint_dir is not None:
             from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
+            faults = getattr(self.executor, "faults", None)
+            if faults is not None:
+                faults.store_point(cid)
             chk_path = write_checkpoint(
                 self.checkpoint_dir, cid, self._with_job_meta(pending.snapshots))
         self._record_completed(pending, chk_path)
@@ -231,7 +255,67 @@ class CheckpointCoordinator:
             self._pending[checkpoint_id] = pending
             self._next_id = max(self._next_id, checkpoint_id + 1)
             self._seed_finished(pending)
+            self._ensure_abort_sweeper_locked()
         return True
+
+    # -- deadline abort ----------------------------------------------------
+    def _ensure_abort_sweeper_locked(self) -> None:
+        """Start the deadline sweeper lazily (caller holds ``_lock``)."""
+        if self._abort_thread is not None or self._abort_stop.is_set():
+            return
+        self._abort_thread = threading.Thread(
+            target=self._abort_loop, name="checkpoint-abort-sweeper",
+            daemon=True,
+        )
+        self._abort_thread.start()
+
+    def _abort_loop(self) -> None:
+        timeout = getattr(self.executor, "checkpoint_timeout_s", 60.0)
+        interval = max(0.02, min(timeout / 4.0, 1.0))
+        cancelled = getattr(self.executor, "cancelled", None)
+        all_done = getattr(self.executor, "_all_done", None)
+        while not self._abort_stop.wait(interval):
+            if ((cancelled is not None and cancelled.is_set())
+                    or (all_done is not None and all_done.is_set())):
+                return
+            now = time.monotonic()
+            expired: typing.List[_PendingCheckpoint] = []
+            with self._lock:
+                for cid, pending in list(self._pending.items()):
+                    if (pending.source_initiated
+                            and now - pending.created_s > timeout):
+                        pending.failed = True
+                        pending.aborted = True
+                        pending.done.set()
+                        del self._pending[cid]
+                        self.aborted_ids.append(cid)
+                        expired.append(pending)
+            for pending in expired:
+                self._announce_abort(
+                    pending.checkpoint_id,
+                    f"missed deadline ({timeout:.1f}s) with "
+                    f"{pending.acks}/{pending.expected} acks",
+                )
+
+    def _announce_abort(self, checkpoint_id: int, why: str) -> None:
+        """Log/trace/flight one declined checkpoint and fan the abort out
+        to the subtasks (they drop the id's alignment state)."""
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "checkpoint %d aborted: %s — discarded; sources keep "
+            "triggering later checkpoints", checkpoint_id, why)
+        if self.tracer is not None:
+            self.tracer.instant("checkpoint", "abort",
+                                args={"checkpoint": checkpoint_id,
+                                      "why": why})
+        flight = getattr(self.executor, "flight", None)
+        if flight is not None:
+            flight.record("checkpoint", "abort",
+                          {"checkpoint": checkpoint_id, "why": why})
+        notify = getattr(self.executor, "notify_checkpoint_aborted", None)
+        if notify is not None:
+            notify(checkpoint_id)
 
     def _complete_locked(self, pending: _PendingCheckpoint) -> None:
         """Finish a source-initiated checkpoint (no trigger() caller).
@@ -260,16 +344,26 @@ class CheckpointCoordinator:
                 from flink_tensorflow_tpu.checkpoint.store import write_checkpoint
 
                 try:
+                    faults = getattr(self.executor, "faults", None)
+                    if faults is not None:
+                        # Chaos plane: a scheduled store_fail raises here
+                        # and takes the same decline path a real disk
+                        # failure would — NOT durable, no commit signal.
+                        faults.store_point(pending.checkpoint_id)
                     chk_path = write_checkpoint(
                         self.checkpoint_dir, pending.checkpoint_id,
                         self._with_job_meta(pending.snapshots))
-                except Exception:  # pragma: no cover - disk trouble
+                except Exception:
                     import logging
 
                     logging.getLogger(__name__).warning(
                         "persisting checkpoint %d failed", pending.checkpoint_id,
                         exc_info=True,
                     )
+                    with self._lock:
+                        self.aborted_ids.append(pending.checkpoint_id)
+                    self._announce_abort(
+                        pending.checkpoint_id, "checkpoint-store write failed")
                     return  # NOT durable: the 2PC commit signal must not fire
                 self._record_completed(pending, chk_path)
                 # Distributed jobs gate the commit signal on the checkpoint
@@ -366,6 +460,7 @@ class CheckpointCoordinator:
                 self._pending[checkpoint_id] = pending
                 self._next_id = checkpoint_id + 1
                 self._seed_finished(pending)
+                self._ensure_abort_sweeper_locked()
             if pending is None:
                 return
             pending.snapshots.setdefault(task, {})[subtask_index] = snapshot
@@ -402,6 +497,7 @@ class CheckpointCoordinator:
                                     self._complete_locked(pending)
 
     def cancel_pending(self) -> None:
+        self._abort_stop.set()
         with self._lock:
             for pending in self._pending.values():
                 pending.failed = True

@@ -183,6 +183,13 @@ class JobConfig:
     #: remains as handshake/wakeup/liveness channel.  Cross-host edges
     #: are unaffected.  FLINK_TPU_SHM=0/1 overrides.
     shm_channels: bool = True
+    #: Deterministic fault-injection plan (core.faults.FaultPlan, a spec
+    #: string, or a sequence of FaultSpec/spec strings): scheduled
+    #: kill/stall/sever/blackhole/delay/store_fail faults pinned to
+    #: (restart epoch, stream position) — the chaos plane that exercises
+    #: the restart/reconnect/abort machinery.  None (the default) keeps
+    #: the production zero-cost path; FLINK_TPU_FAULTS overrides.
+    faults: typing.Optional[typing.Any] = None
     #: Sleep between source emissions — test/backpressure pacing.
     source_throttle_s: float = 0.0
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
@@ -235,6 +242,10 @@ class JobConfig:
                     f"wire_dtype must be one of {WIRE_DTYPES} or None, "
                     f"got {self.wire_dtype!r}"
                 )
+        if self.faults is not None:
+            from flink_tensorflow_tpu.core.faults import FaultPlan
+
+            FaultPlan.resolve(self.faults)  # raises on malformed specs
         if not (0.0 < self.trace_sample_rate <= 1.0):
             raise ValueError(
                 f"trace_sample_rate must be in (0, 1], got {self.trace_sample_rate}"

@@ -57,6 +57,11 @@ from flink_tensorflow_tpu.core.shuffle import RemoteChannelWriter, ShuffleServer
 logger = logging.getLogger(__name__)
 
 
+class CohortPeerLost(ConnectionError):
+    """A cohort peer stopped heartbeating (or never started): the job
+    fails fast so the supervisor's restart protocol takes over."""
+
+
 @dataclasses.dataclass(frozen=True)
 class DistributedConfig:
     """Cohort membership + record-plane endpoints for one process.
@@ -77,6 +82,23 @@ class DistributedConfig:
     #: every this many seconds (a startup burst runs immediately).
     #: 0 disables the service entirely.
     telemetry_interval_s: float = 2.0
+    #: Cohort restart epoch — the supervisor increments it on every
+    #: coordinated restart.  It rides every record-plane handshake as
+    #: the zombie fence: a server of epoch E drops all frames from
+    #: senders that handshook with an epoch < E, so a process of the
+    #: PREVIOUS incarnation that is still dying (stuck in a connect
+    #: retry, draining a send queue) cannot corrupt the restored run's
+    #: stream or its 2PC commit gate.
+    restart_epoch: int = 0
+    #: Cohort death detection: every process heartbeats every peer over
+    #: the control channel, and a peer silent for longer than this fails
+    #: the job fast (CohortPeerLost) so the supervisor restarts the
+    #: cohort from the last complete checkpoint — instead of wedging
+    #: until join() times out.  Catches the HUNG peer (blackholed link,
+    #: livelocked process) that no socket error ever reports.  0 (the
+    #: default) disables heartbeats; transport errors still detect
+    #: outright process death.
+    heartbeat_timeout_s: float = 0.0
 
     def validate(self) -> "DistributedConfig":
         if self.num_processes < 1:
@@ -99,6 +121,10 @@ class DistributedConfig:
             raise ValueError("connect_timeout_s must be > 0")
         if self.telemetry_interval_s < 0:
             raise ValueError("telemetry_interval_s must be >= 0")
+        if self.restart_epoch < 0:
+            raise ValueError("restart_epoch must be >= 0")
+        if self.heartbeat_timeout_s < 0:
+            raise ValueError("heartbeat_timeout_s must be >= 0")
         return self
 
     def endpoint(self, process_index: int) -> typing.Tuple[str, int]:
@@ -153,11 +179,16 @@ class DistributedExecutor(LocalExecutor):
             from flink_tensorflow_tpu.metrics.registry import MetricRegistry
 
             kwargs["metric_registry"] = MetricRegistry()
+        # The cohort restart epoch doubles as the executor's (fault
+        # schedules + flight stamps key on it; the server fences by it).
+        kwargs["restart_epoch"] = max(
+            kwargs.get("restart_epoch", 0), self.dist.restart_epoch)
         _, my_port = self.dist.endpoint(self.dist.process_index)
         self._server = ShuffleServer(
             self.dist.bind, my_port, on_error=self._transport_error,
             on_control=self._on_control,
             metrics=kwargs["metric_registry"],
+            epoch=self.dist.restart_epoch,
         )
         self._remote_writers: typing.List[RemoteChannelWriter] = []
         #: Global 2PC commit point: checkpoint id -> processes that have
@@ -247,8 +278,22 @@ class DistributedExecutor(LocalExecutor):
         #: peers): `flink-tpu-inspect --live --cohort` and the ROADMAP's
         #: autoscaling supervisor poll `cohort_collector.merged_snapshot()`.
         self.cohort_collector = self._telemetry.collector
+        #: Heartbeat death detection (dist.heartbeat_timeout_s > 0):
+        #: peer index -> monotonic time of its last control-plane frame
+        #: (heartbeats, telemetry, durability announcements all count).
+        #: Written by the reactor thread, read by the monitor thread —
+        #: plain dict stores, no lock needed for a staleness check.
+        self._peer_last_seen: typing.Dict[int, float] = {}
+        self._hb_stop = threading.Event()
+        self._hb_thread: typing.Optional[threading.Thread] = None
         self._server.start()
         self._telemetry.start()
+        if self.dist.heartbeat_timeout_s > 0 and self.dist.num_processes > 1:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"cohort-heartbeat:{self.dist.process_index}",
+                daemon=True)
+            self._hb_thread.start()
 
     # -- placement ------------------------------------------------------
     def _owns_subtask(self, t: Transformation, index: int) -> bool:
@@ -274,13 +319,22 @@ class DistributedExecutor(LocalExecutor):
             reactor=self._server.reactor,
             shm=self.shm_channels,
             tracer=self.tracer,
+            epoch=self.dist.restart_epoch,
+            fault_hook=(self.faults.edge_hook(t.name, subtask_index)
+                        if self.faults is not None else None),
         )
         self._remote_writers.append(writer)
         return writer
 
     # -- control plane ---------------------------------------------------
     def _on_control(self, sender: int, message: typing.Any) -> None:
-        kind, cid = message[0], message[1]
+        # Liveness: ANY control frame proves the peer alive (heartbeats
+        # are just the guaranteed-minimum cadence).
+        self._peer_last_seen[sender] = time.monotonic()
+        kind = message[0]
+        if kind == "hb":
+            return
+        cid = message[1]
         if kind == "ckpt_durable":
             with self._durable_cv:
                 self._durable_acks.setdefault(cid, set()).add(sender)
@@ -311,6 +365,7 @@ class DistributedExecutor(LocalExecutor):
                     connect_timeout_s=(
                         self.dist.connect_timeout_s if timeout_s is None
                         else timeout_s),
+                    epoch=self.dist.restart_epoch,
                 )
                 self._control_writers[peer] = writer
             return writer
@@ -321,6 +376,57 @@ class DistributedExecutor(LocalExecutor):
         if self.cancelled.is_set():
             return
         self._get_control_writer(peer).write(message)
+
+    # -- cohort heartbeat / death detection -------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Monitor thread: beat every peer each interval, and fail the
+        job fast when a peer has been silent past the timeout.  A dead
+        process usually also surfaces as a transport error; this path
+        catches the HUNG one — blackholed link, livelocked or stopped
+        process — that keeps its sockets open while delivering nothing.
+        """
+        timeout = self.dist.heartbeat_timeout_s
+        interval = max(0.02, timeout / 3.0)
+        me = self.dist.process_index
+        peers = [p for p in range(self.dist.num_processes) if p != me]
+        beat = ("hb", me, self.dist.restart_epoch)
+        # First-contact grace: cohort startup order is uncoordinated and
+        # a peer may sit in a cold XLA compile before it answers.
+        grace = time.monotonic() + self.dist.connect_timeout_s + timeout
+        while not self._hb_stop.wait(interval):
+            if self.cancelled.is_set():
+                return
+            # Staleness check FIRST: a beat to a dead peer can block in
+            # the writer's reconnect budget, and detection must not wait
+            # behind it.
+            now = time.monotonic()
+            for p in peers:
+                last = self._peer_last_seen.get(p)
+                if last is None:
+                    if now < grace:
+                        continue
+                    silent = now - (grace - timeout)
+                elif now - last <= timeout:
+                    continue
+                else:
+                    silent = now - last
+                exc = CohortPeerLost(
+                    f"cohort peer {p} silent for {silent:.1f}s "
+                    f"(heartbeat_timeout_s={timeout}) — failing fast so "
+                    "the supervisor restarts the cohort from the last "
+                    "complete checkpoint")
+                if self.flight is not None:
+                    self.flight.record("cohort", "peer.lost", {
+                        "peer": p, "silent_s": round(silent, 3),
+                        "epoch": self.dist.restart_epoch})
+                self._transport_error(exc)
+                return
+            for p in peers:
+                try:
+                    self._get_control_writer(p, timeout_s=timeout).write(beat)
+                except Exception:  # noqa: BLE001 — staleness check decides
+                    logger.debug("heartbeat to peer %d failed", p,
+                                 exc_info=True)
 
     # -- global 2PC commit point -----------------------------------------
 
@@ -413,6 +519,9 @@ class DistributedExecutor(LocalExecutor):
 
     def cancel(self) -> None:
         super().cancel()
+        hb_stop = getattr(self, "_hb_stop", None)
+        if hb_stop is not None:
+            hb_stop.set()
         telemetry = getattr(self, "_telemetry", None)
         if telemetry is not None:
             telemetry.stop()
@@ -434,6 +543,7 @@ class DistributedExecutor(LocalExecutor):
         try:
             super().join(timeout)
         finally:
+            self._hb_stop.set()
             telemetry = getattr(self, "_telemetry", None)
             if telemetry is not None:
                 telemetry.stop()

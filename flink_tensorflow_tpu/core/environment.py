@@ -32,15 +32,38 @@ class JobResult:
 
 @dataclasses.dataclass(frozen=True)
 class RestartStrategy:
-    """Flink-style fixed-delay restart (SURVEY.md §5 "Failure detection /
+    """Flink-style restart strategy (SURVEY.md §5 "Failure detection /
     elastic recovery"): on job failure, rebuild the executor, restore the
     latest snapshot from the checkpoint dir, and replay from the source
     offsets.  Operator/keyed state is exactly-once; sink emissions for
-    replayed records are at-least-once (standard non-transactional sinks).
+    replayed records are at-least-once (standard non-transactional sinks)
+    or exactly-once through a 2PC sink (io.files.ExactlyOnceRecordFileSink).
+
+    The default is Flink's fixed-delay shape (``delay_s`` between
+    attempts).  ``backoff_multiplier > 1`` turns it into an exponential
+    restart budget — attempt k waits ``delay_s * multiplier**(k-1)``,
+    capped at ``max_delay_s`` — so a persistently failing job backs off
+    instead of hammering its checkpoint store, and ``jitter`` (a ±
+    fraction, deterministic per metrics seed + attempt) decorrelates
+    fleets restarting off the same outage.
     """
 
     max_restarts: int = 3
     delay_s: float = 0.0
+    backoff_multiplier: float = 1.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.0
+
+    def delay_for(self, attempt: int, *, seed: int = 0) -> float:
+        """Seconds to wait before restart ``attempt`` (1-based)."""
+        delay = self.delay_s * (self.backoff_multiplier ** max(0, attempt - 1))
+        delay = min(delay, self.max_delay_s)
+        if self.jitter and delay > 0:
+            import random
+
+            rng = random.Random((seed or 0) * 1000003 + attempt)
+            delay *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, delay)
 
 
 class JobHandle:
@@ -325,7 +348,7 @@ class StreamExecutionEnvironment:
             return self.config.distributed.process_checkpoint_dir(d)
         return d
 
-    def _make_executor(self) -> LocalExecutor:
+    def _make_executor(self, restart_epoch: int = 0) -> LocalExecutor:
         cfg = self.config.validate()
         # configure(metrics=...) may have changed the seed after the
         # registry was created; histograms pick it up at first use.
@@ -354,6 +377,8 @@ class StreamExecutionEnvironment:
             trace_sample_rate=cfg.trace_sample_rate,
             flight_recorder=cfg.flight_recorder,
             flight_path=cfg.flight_path,
+            faults=cfg.faults,
+            restart_epoch=restart_epoch,
         )
         if cfg.distributed is not None:
             from flink_tensorflow_tpu.core.distributed import DistributedExecutor
@@ -422,23 +447,40 @@ class StreamExecutionEnvironment:
         attempt = 0
         restore = restore_from
         restore_id = restore_checkpoint_id
+        # Recovery observability (carried by cohort metric pushes like
+        # every other scope): restart count + the wall time each
+        # recovery took (failure detected -> restored job running).
+        recovery = self.metric_registry.group("recovery")
+        restarts_total = recovery.counter("restarts_total")
+        recovery_timer = recovery.timer("recovery_duration_s")
+        t_fail: typing.Optional[float] = None
         while True:
             remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
             try:
                 handle = self.execute_async(job_name, restore_from=restore,
                                             restore_checkpoint_id=restore_id,
-                                            report_interval_s=report_interval_s)
+                                            report_interval_s=report_interval_s,
+                                            restart_epoch=attempt)
+                if t_fail is not None:
+                    # The restored job's subtasks are running again:
+                    # failure -> recovered, the headline recovery metric.
+                    recovery_timer.update(time.monotonic() - t_fail)
+                    t_fail = None
                 result = handle.wait(remaining)
                 result.restarts = attempt
                 return result
             except JobTimeout:
                 raise  # the job is slow, not broken — replaying won't help
             except JobFailure:
+                t_fail = time.monotonic()
                 attempt += 1
                 if attempt > restart_strategy.max_restarts:
                     raise
-                if restart_strategy.delay_s:
-                    time.sleep(restart_strategy.delay_s)
+                restarts_total.inc()
+                delay = restart_strategy.delay_for(
+                    attempt, seed=self.config.metrics.seed)
+                if delay:
+                    time.sleep(delay)
                 # Resume from the newest completed checkpoint; before the
                 # first one lands, fall back to the CALLER'S restore point
                 # (or a clean replay when none was given).
@@ -459,10 +501,15 @@ class StreamExecutionEnvironment:
         restore_checkpoint_id: typing.Optional[int] = None,
         validate: bool = False,
         report_interval_s: typing.Optional[float] = None,
+        restart_epoch: int = 0,
     ) -> JobHandle:
+        """``restart_epoch`` stamps which restart attempt this run is
+        (restart strategies pass their attempt counter): the fault plan
+        keys its schedule on it and remote-plane handshakes carry it as
+        the zombie-fencing epoch."""
         if validate:
             self.validate_plan()
-        executor = self._make_executor()
+        executor = self._make_executor(restart_epoch)
         reporter = self._make_reporter(report_interval_s,
                                        flight=executor.flight)
         executor.checkpoint_interval_s = self.checkpoint_interval_s

@@ -601,7 +601,10 @@ class Reactor:
                 threading.current_thread() is not self._thread:
             self._thread.join(timeout=2.0)
         try:
-            for key in list(self._sel.get_map().values()):
+            # A closed selector's get_map() is None (double-close: error
+            # -path cancel followed by the join-path close).
+            mapping = self._sel.get_map()
+            for key in list(mapping.values()) if mapping is not None else ():
                 try:
                     key.fileobj.close()
                 except OSError:

@@ -197,6 +197,13 @@ class _Subtask:
         self.edge_of_channel = edge_of_channel or [0] * num_input_channels
         self.control: "typing.List[int]" = []  # pending checkpoint ids (sources)
         self._control_lock = threading.Lock()
+        #: Aborted-checkpoint ids awaiting delivery to this subtask's
+        #: thread (coordinator deadline sweeps — see notify_checkpoint_
+        #: aborted) and the set already processed: late barriers for an
+        #: aborted id are swallowed instead of starting a new alignment
+        #: that could never complete.
+        self._aborts: "typing.List[int]" = []
+        self._aborted_cids: typing.Set[int] = set()
         #: Checkpoint ids this SPLIT-source subtask already cut its
         #: stream at.  A barrier can now reach the reader on three
         #: paths — control drain (trigger), count-based position, and
@@ -245,6 +252,25 @@ class _Subtask:
             self._notifications.append(checkpoint_id)
         if self.mailbox is not None:
             self.mailbox.notify()
+
+    def add_abort(self, checkpoint_id: int) -> None:
+        """A checkpoint missed its deadline: deliver the abort to this
+        subtask's thread (it drops the id's alignment state and swallows
+        its late barriers)."""
+        with self._control_lock:
+            self._aborts.append(checkpoint_id)
+        if self.mailbox is not None:
+            self.mailbox.notify()
+        elif self.gate is not None:
+            self.gate.wake()
+
+    def _drain_aborts(self) -> typing.List[int]:
+        with self._control_lock:
+            if not self._aborts:
+                return []
+            pending, self._aborts = self._aborts, []
+        self._aborted_cids.update(pending)
+        return pending
 
     def _deliver_notifications(self) -> None:
         with self._control_lock:
@@ -304,6 +330,8 @@ class _Subtask:
     def _source_barrier(self, checkpoint_id: int) -> None:
         """Cut a legacy source's stream at a barrier: snapshot + broadcast
         (with a trace instant marking the injection point when traced)."""
+        if checkpoint_id in self._aborted_cids:
+            return  # deadline-swept checkpoint: do not cut, do not ack
         tracer = self.executor.tracer
         if tracer is not None:
             tracer.instant(self.scope, "barrier.inject",
@@ -322,10 +350,12 @@ class _Subtask:
             throttle = self.executor.source_throttle_s
             every_n = self.executor.checkpoint_every_n
             tracer = self.executor.tracer
+            faults = self.executor.faults
             for value in op.iterate():
                 if self.executor.cancelled.is_set():
                     break
                 self._deliver_notifications()
+                self._drain_aborts()
                 for cid in self._drain_control():
                     self._source_barrier(cid)
                 if isinstance(value, el.SourceIdle):
@@ -337,6 +367,8 @@ class _Subtask:
                 t_emit = time.monotonic()
                 self.output.emit(value)
                 op.record_emitted()
+                if faults is not None:
+                    faults.record_point(self.scope, op.offset)
                 t_done = time.monotonic()
                 # Per-record emit latency: dominated by blocked-put time
                 # when downstream backpressures (the source-side signal);
@@ -379,7 +411,7 @@ class _Subtask:
         per id: the same checkpoint may be requested via trigger
         control, reached count-based, AND served by the freeze-deadlock
         guard — only the first cut snapshots and acks."""
-        if checkpoint_id in self._barriers_cut:
+        if checkpoint_id in self._barriers_cut or checkpoint_id in self._aborted_cids:
             return
         self._barriers_cut.add(checkpoint_id)
         tracer = self.executor.tracer
@@ -420,8 +452,10 @@ class _Subtask:
             throttle = executor.source_throttle_s
             every_n = executor.checkpoint_every_n
             tracer = executor.tracer
+            faults = executor.faults
             while not executor.cancelled.is_set():
                 self._deliver_notifications()
+                self._drain_aborts()
                 for cid in self._drain_control():
                     self._split_barrier(cid)
                 now = time.monotonic()
@@ -436,6 +470,8 @@ class _Subtask:
                     t_emit = time.monotonic()
                     self.output.emit(payload)
                     op.record_emitted()
+                    if faults is not None:
+                        faults.record_point(self.scope, op.offset)
                     t_done = time.monotonic()
                     self.latency.update(t_done - t_emit)
                     if tracer is not None:
@@ -511,6 +547,8 @@ class _Subtask:
         records_in = self.records_in
         latency = self.latency
         tracer = self.executor.tracer
+        faults = self.executor.faults
+        processed = 0
         try:
             self._open_chain()
             active = n
@@ -525,6 +563,15 @@ class _Subtask:
                 poll_start = now
                 item = gate.poll(timeout=timeout)
                 self._deliver_notifications()
+                for cid in self._drain_aborts():
+                    # Deadline-swept checkpoint: drop its alignment (a
+                    # barrier that never arrives must not wedge the gate
+                    # behind blocked channels forever); its stashed
+                    # records replay in order.
+                    if cid in barrier_seen:
+                        del barrier_seen[cid]
+                        barrier_t0.pop(cid, None)
+                        gate.unblock_all()
                 now = time.monotonic()
                 if item is None:
                     # Nothing to process: the poll wait was idle time
@@ -538,6 +585,9 @@ class _Subtask:
                     continue
                 idx, element = item
                 if isinstance(element, el.StreamRecord):
+                    processed += 1
+                    if faults is not None:
+                        faults.record_point(self.scope, processed)
                     if tracer is None:
                         op.process_record_from(self.edge_of_channel[idx], element)
                         latency.update(time.monotonic() - now)
@@ -559,6 +609,12 @@ class _Subtask:
                     records_in.mark()
                 elif isinstance(element, el.CheckpointBarrier):
                     cid = element.checkpoint_id
+                    if cid in self._aborted_cids:
+                        # Late barrier of a deadline-swept checkpoint:
+                        # swallow it — neither blocking (the alignment
+                        # could never complete) nor forwarding (every
+                        # downstream received the same abort).
+                        continue
                     seen = barrier_seen.setdefault(cid, set())
                     if not seen:
                         barrier_t0[cid] = now
@@ -657,6 +713,8 @@ class LocalExecutor:
         wire_flush_bytes: typing.Optional[int] = None,
         wire_flush_ms: typing.Optional[float] = None,
         shm_channels: bool = True,
+        faults: typing.Optional[typing.Any] = None,
+        restart_epoch: int = 0,
     ):
         from flink_tensorflow_tpu import tracing
         from flink_tensorflow_tpu.core import sanitizer_rt
@@ -743,6 +801,28 @@ class LocalExecutor:
         #: metrics that explain the failure are published even if the
         #: caller never joins.
         self.failure_listeners: typing.List[typing.Callable[[], None]] = []
+        #: Which restart attempt of the job this executor runs (0 = the
+        #: first): the fault plan keys its schedule on it, remote-plane
+        #: handshakes carry it as the fencing epoch, and the flight
+        #: recorder stamps it on lifecycle events.
+        self.restart_epoch = restart_epoch
+        #: Chaos plane (core/faults.py): a deterministic fault schedule
+        #: armed for THIS restart epoch — JobConfig.faults or
+        #: FLINK_TPU_FAULTS.  None (the default) keeps the production
+        #: path at one is-None test per hook site.
+        from flink_tensorflow_tpu.core.faults import FaultInjector, FaultPlan
+
+        injector = None
+        plan = FaultPlan.resolve(faults)
+        if plan is not None and plan.specs:
+            injector = FaultInjector(plan, epoch=restart_epoch,
+                                     metrics=self.metrics, flight=self.flight)
+            if not injector.active:
+                # Nothing armed for THIS epoch (e.g. the restarted run
+                # of an epoch-0 schedule): drop back to the zero-cost
+                # no-op path.
+                injector = None
+        self.faults = injector
         self.device_provider = device_provider
         self.mesh = mesh
         self.job_config = job_config or {}
@@ -1018,6 +1098,10 @@ class LocalExecutor:
             # at open() when its own knobs are unset).
             ctx.wire_flush_bytes = self.wire_flush_bytes
             ctx.wire_flush_ms = self.wire_flush_ms
+            # Chaos-plane hand-off: RemoteSink resolves its per-edge
+            # fault hook (sever/blackhole/delay) from this at open().
+            ctx.fault_injector = self.faults
+            ctx.restart_epoch = self.restart_epoch
             if head_gate is not None:
                 # Operator-owned background threads (the model runner's
                 # fetch thread) use this to break the CHAIN's event wait
@@ -1181,7 +1265,11 @@ class LocalExecutor:
             self.flight.record("job", "start", {
                 "subtasks": len(self.subtasks),
                 "logical_subtasks": self.total_subtasks,
+                "restart_epoch": self.restart_epoch,
             })
+            if self.restart_epoch:
+                self.flight.record("job", "restart.attempt", {
+                    "restart_epoch": self.restart_epoch})
         for st in self.subtasks:
             if not st.t.is_source:
                 body = st.run_worker
@@ -1331,6 +1419,18 @@ class LocalExecutor:
         (delivered to each chained operator on the subtask's own thread)."""
         for st in self.subtasks:
             st.add_notification(checkpoint_id)
+
+    def notify_checkpoint_aborted(self, checkpoint_id: int) -> None:
+        """Fan a checkpoint ABORT out: subtasks drop the id's alignment
+        state (unblocking gates a missing barrier wedged) and split
+        coordinators cancel its assignment freeze — the job keeps
+        flowing and sources keep triggering later checkpoints."""
+        for st in self.subtasks:
+            st.add_abort(checkpoint_id)
+        with self._split_lock:
+            coords = list(self._split_coordinators.values())
+        for coord in coords:
+            coord.cancel_alignment(checkpoint_id)
 
     def subtask_finished(self, subtask: _Subtask) -> None:
         if self.flight is not None:

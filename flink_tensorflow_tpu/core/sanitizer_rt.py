@@ -88,6 +88,20 @@ def env_stall_timeout_s() -> typing.Optional[float]:
         return None
 
 
+def env_shake_seed() -> typing.Optional[int]:
+    """``FLINK_TPU_SANITIZE_SHAKE=<seed>``: schedule-fuzzing "shake"
+    mode — seeded randomized delays inside the instrumented lock/condvar
+    wrappers (see ConcurrencySanitizer.shake)."""
+    raw = os.environ.get("FLINK_TPU_SANITIZE_SHAKE")
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("FLINK_TPU_SANITIZE_SHAKE=%r is not an int; ignored", raw)
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class Violation:
     """One recorded sanitizer finding."""
@@ -137,6 +151,7 @@ class InstrumentedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         tid = threading.get_ident()
+        self._san.shake()
         if self._lock.acquire(False):
             self._owner_tid = tid
             self._san.on_acquired(self.name)
@@ -188,6 +203,10 @@ class InstrumentedCondition:
         self.name = name
 
     def wait(self, timeout: typing.Optional[float] = None) -> bool:
+        # Shake BEFORE parking, lock still held: widens the window where
+        # a concurrent notify can land between predicate check and wait
+        # — exactly where lost-wakeup bugs hide.
+        self._san.shake()
         self._san.on_wait_enter(self.name, timed=timeout is not None)
         try:
             return self._cond.wait(timeout)
@@ -195,10 +214,12 @@ class InstrumentedCondition:
             self._san.on_wait_exit()
 
     def notify(self, n: int = 1) -> None:
+        self._san.shake()
         self._san.on_notify(self.name)
         self._cond.notify(n)
 
     def notify_all(self) -> None:
+        self._san.shake()
         self._san.on_notify(self.name)
         self._cond.notify_all()
 
@@ -219,12 +240,26 @@ class ConcurrencySanitizer:
 
     def __init__(self, name: str = "job", *,
                  stall_timeout_s: typing.Optional[float] = None,
-                 raise_on_cycle: bool = True):
+                 raise_on_cycle: bool = True,
+                 shake_seed: typing.Optional[int] = None):
         self.name = name
         self.stall_timeout_s = (
             stall_timeout_s if stall_timeout_s is not None else env_stall_timeout_s()
         )
         self.raise_on_cycle = raise_on_cycle
+        #: Schedule-fuzzing "shake" mode (PR-5 deferral): with a seed,
+        #: every instrumented acquire/wait/notify may inject a tiny
+        #: randomized delay, perturbing the thread schedule so
+        #: interleavings the OS scheduler rarely produces get exercised
+        #: under the SAME invariant checks.  Per-thread RNGs (seeded
+        #: from the shake seed + a per-thread counter) keep the delay
+        #: DISTRIBUTION reproducible without cross-thread locking; the
+        #: schedule itself is of course still the scheduler's.  None
+        #: (default) injects nothing.
+        self.shake_seed = shake_seed if shake_seed is not None else env_shake_seed()
+        self._shake_local = (
+            threading.local() if self.shake_seed is not None else None)
+        self._shake_threads = 0
         self.violations: typing.List[Violation] = []
         #: Span tracer (tracing plane), wired by the executor when BOTH
         #: planes are on: every recorded violation — notably the stall
@@ -256,6 +291,33 @@ class ConcurrencySanitizer:
         self._stop = threading.Event()
         #: (tid, since) incidents the watchdog already flagged.
         self._stalled: typing.Set[typing.Tuple[int, float]] = set()
+
+    # -- shake (schedule fuzzing) ------------------------------------------
+    def shake(self) -> None:
+        """Maybe inject a seeded randomized delay (shake mode only).
+
+        Called from the instrumented wrappers at the points where a
+        reordering changes the observable schedule: before a blocking
+        acquire, before parking in a wait, and before a notify.  Mostly
+        sub-100µs sleeps with an occasional ~1ms one — enough to slide
+        threads past each other across the windows where lost-wakeup /
+        ordering bugs hide, cheap enough to run whole stress suites."""
+        if self._shake_local is None:
+            return
+        rng = getattr(self._shake_local, "rng", None)
+        if rng is None:
+            import random
+
+            with self._mu:
+                self._shake_threads += 1
+                salt = self._shake_threads
+            rng = self._shake_local.rng = random.Random(
+                self.shake_seed * 1000003 + salt)
+        r = rng.random()
+        if r < 0.02:
+            time.sleep(rng.random() * 1e-3)
+        elif r < 0.25:
+            time.sleep(rng.random() * 1e-4)
 
     # -- factories ---------------------------------------------------------
     def lock(self, name: str) -> InstrumentedLock:

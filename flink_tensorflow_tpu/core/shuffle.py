@@ -130,6 +130,40 @@ def env_shm_enabled() -> typing.Optional[bool]:
     return v.lower() in ("1", "true", "on", "yes")
 
 
+def connect_with_retry(host: str, port: int, timeout_s: float, *,
+                       aborted: typing.Optional[typing.Callable[[], bool]] = None
+                       ) -> socket.socket:
+    """TCP connect with bounded exponential backoff: retries any OSError
+    (refused, unreachable, reset during handshake) until ``timeout_s``
+    elapses — the cohort-startup contract (peers come up in any order)
+    AND the reconnect contract (a restarting peer's listener returns
+    within the window).  ``aborted()`` lets a concurrent teardown cut
+    the loop immediately.  Raises TimeoutError past the deadline."""
+    deadline = time.monotonic() + timeout_s
+    backoff = 0.05
+    while True:
+        if aborted is not None and aborted():
+            raise TimeoutError(
+                f"connect to {host}:{port} aborted during retry loop")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"peer {host}:{port} unreachable within {timeout_s}s")
+        try:
+            # Attempts are capped (not at the full remaining window) so
+            # the loop re-polls ``aborted``; 5s still rides out a ~1-3s
+            # SYN retransmit on a congested link.
+            sock = socket.create_connection(
+                (host, port), timeout=min(remaining, 5.0))
+        except OSError:
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2.0, 1.0)
+            continue
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+
 class ColumnarFrame:
     """A coalesced homogeneous record run on the wire: the arrow-style
     payload (tensors/serde.encode_batch bytes) rides as ONE out-of-band
@@ -314,6 +348,12 @@ class _ServerRoute:
         self.channel_idx = -1
         self.gate: typing.Optional["InputGate"] = None
         self.is_control = False
+        #: Restart-epoch fence: a sender whose handshake carries an
+        #: OLDER epoch than this server's is a zombie of a previous run
+        #: — every frame it sends is dropped (counted, never delivered),
+        #: and its disconnect is not a failure.  A zombie must not be
+        #: able to corrupt the restored run's stream.
+        self.stale = False
         self.pending: typing.Deque[typing.Any] = collections.deque()
         self.ring: typing.Optional[ShmByteRing] = None
         self._ring_parser = ShuffleFrameParser()
@@ -337,6 +377,9 @@ class _ServerRoute:
         obj, nbytes = item
         if self.task is None:
             return self._handshake(obj)
+        if self.stale:
+            self.server.count_stale_frame()
+            return True  # fenced: drop everything from the zombie epoch
         if self.is_control:
             if self.server.on_control is not None:
                 self.server.on_control(self.subtask_index, obj)
@@ -349,6 +392,22 @@ class _ServerRoute:
     def _handshake(self, hello) -> bool:
         self.task, self.subtask_index, self.channel_idx = hello[0], hello[1], hello[2]
         self.route = f"{self.task}.{self.subtask_index}[ch{self.channel_idx}]"
+        opts = (hello[3] if len(hello) > 3 and isinstance(hello[3], dict)
+                else {})
+        peer_epoch = opts.get("epoch", 0)
+        if peer_epoch < self.server.epoch:
+            # Zombie sender from before the cohort restart: fence it.
+            # The connection stays open (a raise would look like OUR
+            # failure) but nothing it sends reaches a gate, and its
+            # eventual disconnect is not an error.
+            self.stale = True
+            self.route += f"[stale-epoch-{peer_epoch}]"
+            logger.warning(
+                "fencing zombie sender %s: handshake epoch %d < server "
+                "epoch %d — dropping all frames", self.route, peer_epoch,
+                self.server.epoch)
+            self.server.count_stale_frame()
+            return True
         if self.task == ShuffleServer.CONTROL_TASK:
             # Coordinator control plane: subtask_index is the SENDER
             # process; frames are opaque control messages.  EOF is a
@@ -366,13 +425,13 @@ class _ServerRoute:
         # re-enter on the reactor and continue delivery.
         reactor = self.server.reactor
         gate.add_space_listener(lambda: reactor.submit(self._kick))
-        if len(hello) > 3 and isinstance(hello[3], dict) and "shm" in hello[3]:
+        if "shm" in opts:
             # Same-host upgrade: frames arrive over the shared ring; the
             # socket stays as the notify/liveness channel.  The 5 ms
             # poller is the doorbell-suppression liveness backstop (mmap
             # stores are fence-free — see ShmByteRing's doorbell notes);
             # it runs only while rings are attached.
-            self.ring = ShmByteRing.attach(hello[3]["shm"])
+            self.ring = ShmByteRing.attach(opts["shm"])
             self.route += "[shm]"
             self.server.reactor.add_poller(self._ring_poll, 0.005)
         if self.server.metrics is not None:
@@ -462,6 +521,11 @@ class _ServerRoute:
     # -- teardown --------------------------------------------------------
     def _on_eof(self, clean: bool) -> None:
         self.eof_clean = clean
+        if self.stale:
+            # A fenced zombie going away is the expected outcome, never
+            # a failure of the restored run.
+            self.done = True
+            return
         if not clean:
             self._fail(ConnectionError(
                 f"peer for {self.route} closed mid-frame (stream truncated)"))
@@ -491,6 +555,10 @@ class _ServerRoute:
                 "(upstream process lost)"), force=True)
 
     def _on_io_error(self, exc: BaseException) -> None:
+        if self.stale:
+            self.done = True
+            self.conn.close()
+            return
         self._fail(exc)
 
     def _fail(self, exc: BaseException, force: bool = False) -> None:
@@ -538,7 +606,13 @@ class ShuffleServer:
                  on_error: typing.Optional[typing.Callable[[BaseException], None]] = None,
                  on_control: typing.Optional[typing.Callable[[int, typing.Any], None]] = None,
                  metrics: typing.Optional[typing.Any] = None,
-                 reactor: typing.Optional[Reactor] = None):
+                 reactor: typing.Optional[Reactor] = None,
+                 epoch: int = 0):
+        #: Restart-epoch fence (DistributedConfig.restart_epoch): a
+        #: handshake carrying an older epoch marks a zombie sender from
+        #: a previous incarnation of the cohort; its frames are dropped.
+        self.epoch = epoch
+        self._stale_frames = None  # lazy Counter (reactor single-writer)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((bind, port))
@@ -559,6 +633,15 @@ class ShuffleServer:
 
     def register_gate(self, task: str, subtask_index: int, gate: "InputGate") -> None:
         self._gates[(task, subtask_index)] = gate
+
+    def count_stale_frame(self) -> None:
+        """One dropped zombie-epoch frame (reactor thread only)."""
+        if self.metrics is None:
+            return
+        if self._stale_frames is None:
+            self._stale_frames = self.metrics.group("recovery").counter(
+                "stale_epoch_frames")
+        self._stale_frames.inc()
 
     def start(self) -> None:
         self.reactor.start()
@@ -667,13 +750,31 @@ class RemoteChannelWriter:
                  reactor: typing.Optional[Reactor] = None,
                  shm: bool = False,
                  shm_ring_bytes: int = 8 << 20,
-                 tracer: typing.Optional[typing.Any] = None):
+                 tracer: typing.Optional[typing.Any] = None,
+                 epoch: int = 0,
+                 reconnect_timeout_s: float = 5.0,
+                 fault_hook: typing.Optional[typing.Callable[[], typing.Optional[str]]] = None):
         self.host = host
         self.port = port
         self.task = task
         self.subtask_index = subtask_index
         self.channel_idx = channel_idx
         self.connect_timeout_s = connect_timeout_s
+        #: Cohort restart epoch carried in the handshake: a receiver of
+        #: a NEWER epoch fences this writer as a zombie (frames dropped).
+        self.epoch = epoch
+        #: Self-healing send path: on a transport failure, retry
+        #: connect+handshake with exponential backoff within this budget
+        #: and resend the in-flight frame.  Frame encoding is atomic
+        #: writer-side, so a failure BEFORE any byte left (injected
+        #: sever, refused connect, reset between frames) recovers
+        #: loss-free; a mid-frame break still truncates the receiver's
+        #: parser and fails the peer loudly (restart recovers).  0
+        #: restores the fail-fast pre-chaos wire.
+        self.reconnect_timeout_s = reconnect_timeout_s
+        #: Chaos plane (core/faults.py): per-frame injection hook —
+        #: None (production) costs one is-None test per flush.
+        self._fault_hook = fault_hook
         env_b, env_ms = env_flush_bytes(), env_flush_ms()
         self.flush_bytes = (env_b if env_b is not None
                             else flush_bytes if flush_bytes is not None
@@ -710,6 +811,8 @@ class RemoteChannelWriter:
         self._flush_counters = None
         self._frame_records = self._frame_bytes = None
         self._flush_total = None
+        self._reconnects = None
+        self._edge_reconnects = None
         if metrics is not None:
             # Per-channel scope: every flush runs under this writer's
             # lock, so the counters stay effectively single-writer
@@ -727,6 +830,12 @@ class RemoteChannelWriter:
             # Job-wide flush meter (Meter is thread-safe): one rate for
             # the whole plane, reasons attributed per edge above.
             self._flush_total = metrics.group("wire").meter("flush_total")
+            # Recovery observability: successful reconnect+resend cycles
+            # — per edge, plus the job-wide edge_reconnects meter every
+            # writer shares (Meter is thread-safe).
+            self._reconnects = group.counter("reconnects")
+            self._edge_reconnects = metrics.group("recovery").meter(
+                "edge_reconnects")
             # Reactor-mode writers park frames on a bounded send queue;
             # depth / bytes-pending show WHICH edge a slow peer or a
             # stalled loop is backing up (0 for blocking/standalone
@@ -739,36 +848,16 @@ class RemoteChannelWriter:
                                  else self._conn.send_queue_bytes))
 
     # -- connection ------------------------------------------------------
-    def _connect(self) -> None:
-        deadline = time.monotonic() + self.connect_timeout_s
-        while True:
-            # A concurrent close() (job cancel) must abort the retry loop
-            # immediately — otherwise teardown can stall behind a writer
-            # spinning on a peer that died (ADVICE r3 low).
-            if self._closed:
-                raise TimeoutError(
-                    f"writer to {self.host}:{self.port} closed during connect"
-                )
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"shuffle peer {self.host}:{self.port} unreachable "
-                    f"within {self.connect_timeout_s}s"
-                )
-            try:
-                # Attempts are capped (not at the full remaining window)
-                # only so the loop re-polls _closed; 5s keeps teardown
-                # responsive while still riding out a ~1-3s SYN
-                # retransmit on a congested link.
-                self._sock = socket.create_connection(
-                    (self.host, self.port), timeout=min(remaining, 5.0)
-                )
-                break
-            except OSError:
-                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        hello: typing.Tuple = (self.task, self.subtask_index, self.channel_idx)
+    def _connect(self, timeout_s: typing.Optional[float] = None) -> None:
+        # A concurrent close() (job cancel) aborts the retry loop
+        # immediately — otherwise teardown can stall behind a writer
+        # spinning on a peer that died (ADVICE r3 low).
+        self._sock = connect_with_retry(
+            self.host, self.port,
+            self.connect_timeout_s if timeout_s is None else timeout_s,
+            aborted=lambda: self._closed,
+        )
+        opts: typing.Dict[str, typing.Any] = {"epoch": self.epoch}
         if self.shm:
             path = os.path.join(
                 shm_dir(),
@@ -776,8 +865,9 @@ class RemoteChannelWriter:
                 f"{abs(hash((self.task, self.subtask_index, self.channel_idx))) % (1 << 32):08x}",
             )
             self._ring = ShmByteRing.create(path, self.shm_ring_bytes)
-            hello = hello + ({"shm": path, "capacity": self._ring.capacity},)
-        _send_obj(self._sock, hello)
+            opts.update({"shm": path, "capacity": self._ring.capacity})
+        _send_obj(self._sock,
+                  (self.task, self.subtask_index, self.channel_idx, opts))
         if self._reactor is not None and self._ring is None:
             # Async sends: the reactor drains a bounded queue; errors
             # surface on the next write through the stored exception.
@@ -930,31 +1020,11 @@ class RemoteChannelWriter:
 
     def _send_parts(self, parts, payload_bytes: int) -> None:
         try:
+            if self._fault_hook is not None and self._fault_hook() == "drop":
+                return  # injected blackhole: the frame vanishes on the wire
             if self._sock is None:
                 self._connect()
-            if self._ring is not None:
-                total = sum(
-                    p.nbytes if isinstance(p, memoryview) else len(p)
-                    for p in parts)
-                while not self._ring.try_write_parts(parts, total):
-                    # Ring full = same-host backpressure: back off until
-                    # the consumer drains (its gate freed space) or the
-                    # job tears down.
-                    if self._closed:
-                        return
-                    time.sleep(0.0001)
-                # Doorbell suppression: ring the socket only when the
-                # consumer declared itself parked — a draining consumer
-                # sees the published tail without any syscall at all.
-                # (The receiver keeps a bounded ring re-poll, so the
-                # fence-free park/publish race cannot strand frames.)
-                if self._ring.consumer_parked():
-                    self._ring.set_consumer_parked(False)
-                    self._sock.sendall(_ring_notify_wire())
-            elif self._conn is not None:
-                self._conn.send(parts)
-            else:
-                _sendall_parts(self._sock, parts)
+            self._transmit(parts)
         except (OSError, ConnectionError):
             # Drop the dead transport so a LATER write reconnects instead
             # of failing forever on the cached fd (control writers are
@@ -963,7 +1033,67 @@ class RemoteChannelWriter:
             self._teardown_transport()
             if self._closed:
                 return
+            if self._reconnect_and_resend(parts):
+                return
             raise  # peer loss surfaces as subtask failure -> job failure
+
+    def _transmit(self, parts) -> None:
+        if self._ring is not None:
+            total = sum(
+                p.nbytes if isinstance(p, memoryview) else len(p)
+                for p in parts)
+            while not self._ring.try_write_parts(parts, total):
+                # Ring full = same-host backpressure: back off until
+                # the consumer drains (its gate freed space) or the
+                # job tears down.
+                if self._closed:
+                    return
+                time.sleep(0.0001)
+            # Doorbell suppression: ring the socket only when the
+            # consumer declared itself parked — a draining consumer
+            # sees the published tail without any syscall at all.
+            # (The receiver keeps a bounded ring re-poll, so the
+            # fence-free park/publish race cannot strand frames.)
+            if self._ring.consumer_parked():
+                self._ring.set_consumer_parked(False)
+                self._sock.sendall(_ring_notify_wire())
+        elif self._conn is not None:
+            self._conn.send(parts)
+        else:
+            _sendall_parts(self._sock, parts)
+
+    def _reconnect_and_resend(self, parts) -> bool:
+        """Exponential-backoff reconnect after a transport failure,
+        resending the in-flight frame; True on success.  The peer's
+        listener may be a RESTARTED incarnation — its server fences this
+        writer by epoch if the cohort moved on, so a zombie's resend can
+        never corrupt the restored run."""
+        budget = self.reconnect_timeout_s
+        if budget <= 0:
+            return False
+        deadline = time.monotonic() + budget
+        backoff = 0.05
+        attempt = 0
+        while not self._closed and time.monotonic() < deadline:
+            attempt += 1
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2.0, 1.0)
+            try:
+                self._connect(timeout_s=max(0.05, deadline - time.monotonic()))
+                self._transmit(parts)
+            except (OSError, ConnectionError, TimeoutError):
+                self._teardown_transport()
+                continue
+            if self._reconnects is not None:
+                self._reconnects.inc()
+                self._edge_reconnects.mark()
+            logger.warning(
+                "edge to %s.%d[ch%d] at %s:%d re-established after %d "
+                "attempt(s); in-flight frame resent", self.task,
+                self.subtask_index, self.channel_idx, self.host, self.port,
+                attempt)
+            return True
+        return False
 
     def _teardown_transport(self) -> None:
         if self._conn is not None:

@@ -52,7 +52,7 @@ import typing
 
 from flink_tensorflow_tpu.core import functions as fn
 from flink_tensorflow_tpu.core.reactor import FlushScheduler, LengthPrefixedParser
-from flink_tensorflow_tpu.core.shuffle import _sendall_parts
+from flink_tensorflow_tpu.core.shuffle import _sendall_parts, connect_with_retry
 from flink_tensorflow_tpu.tensors.serde import (
     batch_signature,
     decode_frame,
@@ -76,12 +76,22 @@ class RemoteSink(fn.SinkFunction):
                  wire_dtype: typing.Optional[str] = None,
                  flush_bytes: typing.Optional[int] = None,
                  flush_ms: typing.Optional[float] = None,
-                 columnar: bool = True):
+                 columnar: bool = True,
+                 reconnect_timeout_s: float = 5.0):
         from flink_tensorflow_tpu.tensors.serde import normalize_wire_dtype
 
         self.host = host
         self.port = port
         self.connect_timeout_s = connect_timeout_s
+        #: Self-healing send path: a burst whose send fails reconnects
+        #: with exponential backoff within this budget and is resent
+        #: whole (the peer RemoteSource holds the fan-in slot open for
+        #: the replacement connection).  Frames already swallowed by the
+        #: dead socket's kernel buffer are NOT resent — raw TCP pipes
+        #: stay at-least-once (module docstring; the exactly-once
+        #: boundary lint points at the durable-WAL pattern).  0 restores
+        #: fail-fast sends.
+        self.reconnect_timeout_s = reconnect_timeout_s
         #: Compact on-the-wire dtype for float fields (tensors/serde.py);
         #: None defers to JobConfig.wire_dtype at open().
         self.wire_dtype = normalize_wire_dtype(wire_dtype)
@@ -106,6 +116,9 @@ class RemoteSink(fn.SinkFunction):
         self._flush_counters: typing.Optional[dict] = None
         self._frame_records = self._frame_bytes = None
         self._flush_total = None
+        self._fault_hook = None
+        self._reconnects = None
+        self._edge_reconnects = None
 
     def clone(self):
         return RemoteSink(self.host, self.port,
@@ -113,7 +126,8 @@ class RemoteSink(fn.SinkFunction):
                           wire_dtype=self.wire_dtype,
                           flush_bytes=self.flush_bytes,
                           flush_ms=self.flush_ms,
-                          columnar=self.columnar)
+                          columnar=self.columnar,
+                          reconnect_timeout_s=self.reconnect_timeout_s)
 
     def open(self, ctx) -> None:
         from flink_tensorflow_tpu.core.shuffle import (
@@ -149,27 +163,25 @@ class RemoteSink(fn.SinkFunction):
             self._frame_records = ctx.metrics.histogram("frame_records")
             self._frame_bytes = ctx.metrics.histogram("frame_bytes")
             self._flush_total = ctx.metrics.meter("wire_flush_total")
+            self._reconnects = ctx.metrics.counter("reconnects")
+            registry = getattr(ctx.metrics, "_registry", None)
+            if registry is not None:
+                self._edge_reconnects = registry.group("recovery").meter(
+                    "edge_reconnects")
+        # Chaos plane: sever/blackhole/delay specs targeting this sink's
+        # subtask fire inside _flush_locked (core/faults.py).
+        injector = getattr(ctx, "fault_injector", None)
+        if injector is not None:
+            self._fault_hook = injector.edge_hook(
+                ctx.task_name, ctx.subtask_index)
 
-        # Retry refused connections until the deadline: in a cohort the
-        # peer's listener may come up after this job starts (process
-        # startup order is not coordinated).
-        deadline = time.monotonic() + self.connect_timeout_s
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"RemoteSink could not reach {self.host}:{self.port} "
-                    f"within {self.connect_timeout_s}s"
-                )
-            try:
-                self._sock = socket.create_connection(
-                    (self.host, self.port), timeout=remaining
-                )
-                break
-            except ConnectionRefusedError:
-                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Bounded-backoff connect retry (the same loop the shuffle plane
+        # uses for cohort startup): ANY OSError — refused, unreachable,
+        # reset mid-handshake — retries until the deadline, because the
+        # peer's listener may come up, or come BACK up, after this job
+        # starts.
+        self._sock = connect_with_retry(
+            self.host, self.port, self.connect_timeout_s)
 
     def invoke(self, value) -> None:
         if not isinstance(value, TensorValue):
@@ -262,8 +274,7 @@ class RemoteSink(fn.SinkFunction):
                 parts.append(payload)
         burst_bytes = sum(len(p) for p in parts)
         t1 = time.monotonic()
-        # Scatter-gather: one sendmsg per burst, no concatenation copy.
-        _sendall_parts(self._sock, parts)
+        self._send_burst(parts)
         t2 = time.monotonic()
         if self._flush_counters is not None:
             self._flush_counters[reason].inc()
@@ -282,6 +293,62 @@ class RemoteSink(fn.SinkFunction):
             tracer.span(self._track, "wire", t1, t2,
                         args={"bytes": burst_bytes})
 
+    def _send_burst(self, parts) -> None:
+        """One burst onto the wire (scatter-gather sendmsg, no
+        concatenation copy), with the chaos hook and the self-healing
+        retry: a failed send reconnects with exponential backoff within
+        ``reconnect_timeout_s`` and resends the whole burst — the peer
+        RemoteSource keeps the fan-in slot open for the replacement
+        connection (see its reconnect grace)."""
+        try:
+            if self._fault_hook is not None and self._fault_hook() == "drop":
+                return  # injected blackhole: the burst vanishes
+            _sendall_parts(self._sock, parts)
+            return
+        except (OSError, ConnectionError):
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            if self.reconnect_timeout_s <= 0:
+                raise
+        deadline = time.monotonic() + self.reconnect_timeout_s
+        backoff = 0.05
+        attempt = 0
+        while True:
+            attempt += 1
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2.0, 1.0)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"RemoteSink to {self.host}:{self.port}: send failed and "
+                    f"reconnect did not succeed within "
+                    f"{self.reconnect_timeout_s}s")
+            try:
+                self._sock = connect_with_retry(
+                    self.host, self.port, max(0.05, remaining))
+                _sendall_parts(self._sock, parts)
+            except (OSError, ConnectionError, TimeoutError):
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                continue
+            if self._reconnects is not None:
+                self._reconnects.inc()
+            if self._edge_reconnects is not None:
+                self._edge_reconnects.mark()
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "RemoteSink to %s:%d re-established after %d attempt(s); "
+                "in-flight burst resent", self.host, self.port, attempt)
+            return
+
     def close(self) -> None:
         if self._sock is not None:
             with self._lock:
@@ -289,6 +356,15 @@ class RemoteSink(fn.SinkFunction):
                     self._flush_locked("close")
                 except (OSError, ConnectionError):
                     pass  # peer already gone; nothing left to preserve
+            try:
+                # End-of-stream marker (a zero-length frame): the peer
+                # RemoteSource counts this peer DONE only after seeing
+                # it — a bare FIN is treated as an unclean drop eligible
+                # for reconnect, so sink restarts and severed links are
+                # distinguishable from completion.
+                self._sock.sendall(_LEN.pack(0))
+            except OSError:
+                pass
             try:
                 self._sock.shutdown(socket.SHUT_WR)
             except OSError:
@@ -314,9 +390,16 @@ class RemoteSource(fn.SourceFunction):
     records, so a slow job closes the kernel TCP windows.
     """
 
+    #: Plan-time marker for the `exactly-once-boundary` lint: a TCP
+    #: stream cannot be rewound to a checkpoint offset, so jobs that
+    #: replay after failure re-read NOTHING from this source — delivery
+    #: through it is at-least-once unless fronted by a durable log.
+    replayable = False
+
     def __init__(self, bind: str = "0.0.0.0", port: int = 0,
                  *, fan_in: int = 1, accept_timeout_s: float = 60.0,
-                 queue_capacity: int = 1024):
+                 queue_capacity: int = 1024,
+                 reconnect_grace_s: float = 5.0):
         if fan_in < 1:
             raise ValueError(f"fan_in must be >= 1, got {fan_in}")
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -326,6 +409,13 @@ class RemoteSource(fn.SourceFunction):
         self.port = self._listener.getsockname()[1]
         self.fan_in = fan_in
         self.accept_timeout_s = accept_timeout_s
+        #: Self-healing fan-in: a peer that drops WITHOUT the
+        #: end-of-stream marker (reset, sink-side sever, truncated
+        #: frame) frees its slot and the source waits this long for the
+        #: peer to reconnect (RemoteSink resends its in-flight burst on
+        #: the replacement connection) before failing loudly.  0
+        #: restores fail-fast.
+        self.reconnect_grace_s = reconnect_grace_s
         #: Retained for API compatibility; the threadless loop needs no
         #: hand-off queue (its backlog is the per-connection parser).
         self.queue_capacity = queue_capacity
@@ -356,20 +446,55 @@ class RemoteSource(fn.SourceFunction):
         self._listener.setblocking(False)
         sel.register(self._listener, selectors.EVENT_READ, None)
         parsers: typing.Dict[socket.socket, LengthPrefixedParser] = {}
+        #: Peers whose end-of-stream marker arrived: their EOF is clean
+        #: completion; any other drop is reconnect-eligible.
+        eos: typing.Set[socket.socket] = set()
         ready: typing.Deque[TensorValue] = collections.deque()
-        accepted = closed = 0
+        started = closed = 0      # first-time accepts / completed peers
+        lost = 0                  # unclean drops awaiting reconnect
+        lost_deadline = 0.0
         deadline = time.monotonic() + self.accept_timeout_s
         tracer = self._tracer
+
+        def drop_unclean(conn: socket.socket, why: str):
+            nonlocal lost, lost_deadline
+            sel.unregister(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            del parsers[conn]
+            eos.discard(conn)
+            if self.reconnect_grace_s <= 0:
+                raise ConnectionError(
+                    f"remote peer dropped uncleanly ({why}) and "
+                    "reconnect_grace_s=0")
+            lost += 1
+            lost_deadline = time.monotonic() + self.reconnect_grace_s
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "remote peer dropped uncleanly (%s); holding its fan-in "
+                "slot %.1fs for a reconnect", why, self.reconnect_grace_s)
+
         try:
             while closed < self.fan_in:
                 # Drain decoded records FIRST: reading more while the
                 # pipeline lags would just buffer unboundedly.
                 while ready:
                     yield ready.popleft()
-                if accepted < self.fan_in and time.monotonic() > deadline:
+                now = time.monotonic()
+                if started < self.fan_in and now > deadline:
                     raise TimeoutError(
-                        f"RemoteSource accepted {accepted}/{self.fan_in} "
+                        f"RemoteSource accepted {started}/{self.fan_in} "
                         f"peers within {self.accept_timeout_s}s"
+                    )
+                if lost > 0 and now > lost_deadline:
+                    raise ConnectionError(
+                        f"{lost} remote peer(s) dropped uncleanly and did "
+                        f"not reconnect within {self.reconnect_grace_s}s "
+                        "(records in the dead connection's kernel buffer "
+                        "are lost — TCP sources are at-least-once)"
                     )
                 events = sel.select(timeout=0.1)
                 if not events:
@@ -377,7 +502,7 @@ class RemoteSource(fn.SourceFunction):
                     continue
                 for key, _ in events:
                     if key.fileobj is self._listener:
-                        if accepted >= self.fan_in:
+                        if started >= self.fan_in and lost <= 0:
                             continue
                         try:
                             conn, _addr = self._listener.accept()
@@ -386,7 +511,17 @@ class RemoteSource(fn.SourceFunction):
                         conn.setblocking(False)
                         parsers[conn] = LengthPrefixedParser()
                         sel.register(conn, selectors.EVENT_READ, None)
-                        accepted += 1
+                        if lost > 0:
+                            # A dropped peer came back: the sink resends
+                            # its in-flight burst on this connection.
+                            lost -= 1
+                            import logging
+
+                            logging.getLogger(__name__).info(
+                                "remote peer reconnected; %d still lost",
+                                lost)
+                        else:
+                            started += 1
                         continue
                     conn = typing.cast(socket.socket, key.fileobj)
                     parser = parsers[conn]
@@ -394,18 +529,29 @@ class RemoteSource(fn.SourceFunction):
                         chunk = conn.recv(1 << 20)
                     except (BlockingIOError, InterruptedError):
                         continue
+                    except OSError as exc:
+                        drop_unclean(conn, f"recv failed: {exc!r}")
+                        continue
                     if not chunk:
                         if parser.buffered:
-                            raise ConnectionError(
-                                "remote peer closed mid-frame (stream "
-                                "truncated)"
-                            )
+                            drop_unclean(conn, "closed mid-frame")
+                            continue
+                        if conn not in eos:
+                            drop_unclean(conn, "closed without end-of-"
+                                               "stream marker")
+                            continue
                         sel.unregister(conn)
                         conn.close()
                         del parsers[conn]
-                        closed += 1
+                        eos.discard(conn)
                         continue
                     for payload, length in parser.feed(chunk):
+                        if length == 0:
+                            # End-of-stream marker: this peer is DONE —
+                            # only now does its slot count completed.
+                            eos.add(conn)
+                            closed += 1
+                            continue
                         if tracer is None:
                             ready.extend(decode_frame(payload))
                         else:

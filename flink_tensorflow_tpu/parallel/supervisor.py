@@ -57,7 +57,12 @@ class CohortSupervisor:
     worker; ``env(worker_id, num_workers, attempt)`` (optional) returns
     extra environment variables.  The attempt number lets the command
     builder pick a fresh coordinator port per round (a dead coordinator
-    socket can linger in TIME_WAIT) and lets workers decide to restore.
+    socket can linger in TIME_WAIT), lets workers decide to restore, and
+    should be threaded into ``DistributedConfig.restart_epoch`` so the
+    restored cohort's record plane FENCES the previous attempt's zombie
+    senders (a dying worker of attempt k-1 may still be flushing into
+    attempt k's ports — its stale-epoch frames are dropped, never
+    delivered; see core/shuffle.py).
 
     Failure policy: the FIRST nonzero worker exit fails the whole attempt
     — the survivors are sent SIGTERM (SIGKILL after ``kill_grace_s``) and

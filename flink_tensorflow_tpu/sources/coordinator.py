@@ -182,6 +182,17 @@ class SplitCoordinator:
             return sorted(cid for cid, passed in self._aligning.items()
                           if reader_index not in passed)
 
+    def cancel_alignment(self, checkpoint_id: int) -> None:
+        """The coordinator declined ``checkpoint_id`` at its deadline:
+        drop its alignment freeze and staged pool snapshot so assignment
+        thaws and readers stop seeing it as pending — the stuck barrier
+        must not freeze split dispensing forever."""
+        with self._lock:
+            dropped = self._aligning.pop(checkpoint_id, None)
+            self._chk_state.pop(checkpoint_id, None)
+        if dropped is not None:
+            self._notify_all()
+
     def reader_finished(self, reader_index: int) -> None:
         """A reader's subtask ended (bounded input drained or failure
         teardown): it counts as passed for every current and future

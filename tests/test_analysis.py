@@ -802,3 +802,56 @@ class TestDeviceResidencyLint:
                        "device-residency") == []
         on = env.configure(device_resident=True).config
         assert by_rule(analyze(env.graph, config=on), "device-residency") != []
+
+
+class TestExactlyOnceBoundaryLint:
+    """exactly-once-boundary: restartable plan behind a non-replayable
+    (TCP) source is at-least-once — the documented io/remote.py hole."""
+
+    @staticmethod
+    def _tcp_source():
+        from flink_tensorflow_tpu.io.remote import RemoteSource
+
+        return RemoteSource(bind="127.0.0.1")
+
+    def test_checkpointed_remote_source_warns(self):
+        src = self._tcp_source()
+        try:
+            env = StreamExecutionEnvironment(parallelism=1)
+            env.enable_checkpointing("/tmp/eob-lint")
+            env.from_source(src, name="tcp").sink_to_list()
+            diags = by_rule(analyze(env.graph, config=env.config),
+                            "exactly-once-boundary")
+            assert len(diags) == 1
+            assert diags[0].severity == Severity.WARN
+            assert diags[0].node == "tcp"
+            assert "FileSplitSource" in diags[0].message
+        finally:
+            src.close()
+
+    def test_no_checkpointing_no_warning(self):
+        src = self._tcp_source()
+        try:
+            env = StreamExecutionEnvironment(parallelism=1)
+            env.from_source(src, name="tcp").sink_to_list()
+            assert by_rule(analyze(env.graph, config=env.config),
+                           "exactly-once-boundary") == []
+        finally:
+            src.close()
+
+    def test_replayable_sources_stay_clean(self):
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing("/tmp/eob-lint")
+        env.from_collection([1, 2, 3]).sink_to_list()
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "exactly-once-boundary") == []
+
+    def test_bare_graph_without_config_skips(self):
+        src = self._tcp_source()
+        try:
+            env = StreamExecutionEnvironment(parallelism=1)
+            env.enable_checkpointing("/tmp/eob-lint")
+            env.from_source(src, name="tcp").sink_to_list()
+            assert by_rule(analyze(env.graph), "exactly-once-boundary") == []
+        finally:
+            src.close()

@@ -13,6 +13,12 @@ once under ``FLINK_TPU_SANITIZE=1`` with a sanitizer-instrumented gate
 (PR 5) — the same properties must hold AND the happens-before recorder
 must report zero violations (no lock-order inversion, no delivery past
 a blocked channel) across the full randomized schedule.
+
+Slow mode adds a third arm: ``FLINK_TPU_SANITIZE_SHAKE=<seed>``
+schedule fuzzing — the instrumented wrappers inject seeded randomized
+delays at acquire/wait/notify so interleavings the OS scheduler rarely
+produces get exercised under the same invariants (the PR-5 "shake"
+deferral).
 """
 
 import random
@@ -51,14 +57,23 @@ class _SanitizedGateFactory:
             v.format() for v in self.san.violations]
 
 
-@pytest.fixture(params=["plain", "sanitized"])
+@pytest.fixture(params=[
+    "plain",
+    "sanitized",
+    pytest.param("shake", marks=pytest.mark.slow),
+])
 def gate_factory(request, monkeypatch):
     if request.param == "plain":
         yield _plain_gate
         return
     monkeypatch.setenv("FLINK_TPU_SANITIZE", "1")
+    if request.param == "shake":
+        monkeypatch.setenv("FLINK_TPU_SANITIZE_SHAKE", "20260804")
+        assert sanitizer_rt.env_shake_seed() == 20260804
     assert sanitizer_rt.env_enabled()
     factory = _SanitizedGateFactory()
+    if request.param == "shake":
+        assert factory.san.shake_seed == 20260804
     yield factory
     factory.assert_clean()
 

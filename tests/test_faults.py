@@ -1,0 +1,491 @@
+"""Chaos plane + self-healing cohort (ISSUE 11).
+
+Every fault class the plan can schedule — kill, sever, stall,
+checkpoint-store failure — must recover with output BYTE-IDENTICAL to a
+fault-free run of the same job, verified through the 2PC sink's
+``read_committed()`` (the repo's exactly-once oracle).  Plus the
+machinery the faults force into existence: checkpoint deadline abort
+(a stuck barrier no longer wedges the job), restart-epoch fencing
+(zombie senders cannot corrupt a restored run), restart-budget backoff,
+and cohort heartbeat death detection.
+"""
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core import functions as fn
+from flink_tensorflow_tpu.core.environment import RestartStrategy
+from flink_tensorflow_tpu.core.faults import (
+    FaultPlan,
+    FaultSpec,
+    parse_fault_spec,
+)
+from flink_tensorflow_tpu.core.runtime import JobFailure
+from flink_tensorflow_tpu.core.state import StateDescriptor
+from flink_tensorflow_tpu.io.files import ExactlyOnceRecordFileSink, read_committed
+from flink_tensorflow_tpu.tensors import TensorValue
+from flink_tensorflow_tpu.tensors.serde import encode_record
+
+SUM = StateDescriptor("sum", default_factory=lambda: 0)
+NUM_KEYS = 4
+
+
+class KeyedSum(fn.ProcessFunction):
+    """Running per-key sum in keyed state: any duplicated or skipped
+    record after recovery shows up as a wrong sum somewhere downstream,
+    so byte-equality of the committed output IS the exactly-once proof."""
+
+    def process_element(self, value, ctx, out):
+        state = ctx.state(SUM)
+        cur = state.value() + int(value)
+        state.update(cur)
+        out.collect(TensorValue(
+            {"v": np.int64(cur)},
+            {"key": int(ctx.current_key), "i": int(value)},
+        ))
+
+
+def committed_bytes(out_dir):
+    """Canonical byte-level digest of a 2PC sink directory: the sorted
+    serialized records (sorting removes subtask-interleaving order,
+    nothing else)."""
+    return sorted(bytes(encode_record(r)) for r in read_committed(out_dir))
+
+
+def run_keyed_job(tmp_path, tag, *, n=120, every=20, faults=None,
+                  restart=None, throttle=0.002, timeout_s=0.0,
+                  parallelism=2):
+    """source -> key_by -> KeyedSum (par 2) -> 2PC sink, count-based
+    checkpoints; returns (env, out_dir)."""
+    out = str(tmp_path / f"out-{tag}")
+    env = StreamExecutionEnvironment(parallelism=parallelism)
+    env.enable_checkpointing(str(tmp_path / f"chk-{tag}"),
+                             every_n_records=every)
+    if timeout_s:
+        env.configure(checkpoint=dataclasses.replace(
+            env.config.checkpoint, timeout_s=timeout_s))
+    if faults is not None:
+        env.configure(faults=faults)
+    env.source_throttle_s = throttle
+    (
+        env.from_collection(list(range(n)), name="src")
+        .key_by(lambda x: x % NUM_KEYS)
+        .process(KeyedSum(), name="count", parallelism=parallelism)
+        .add_sink(ExactlyOnceRecordFileSink(out), name="sink",
+                  parallelism=1)
+    )
+    env.execute(f"faults-{tag}", timeout=120, restart_strategy=restart)
+    return env, out
+
+
+class TestFaultPlan:
+    def test_spec_grammar(self):
+        assert parse_fault_spec("kill:count.0@50") == FaultSpec(
+            "kill", "count", 0, 50)
+        assert parse_fault_spec("stall:count.1@80~0.5#1") == FaultSpec(
+            "stall", "count", 1, 80, duration_s=0.5, epoch=1)
+        assert parse_fault_spec("store_fail@2") == FaultSpec(
+            "store_fail", at=2)
+        assert parse_fault_spec("delay:sum.1@5~0.01x3") == FaultSpec(
+            "delay", "sum", 1, 5, duration_s=0.01, count=3)
+        plan = FaultPlan.parse("kill:a.0@1;sever:b.1@2")
+        assert [s.kind for s in plan.specs] == ["kill", "sever"]
+
+    def test_malformed_specs_raise(self):
+        for bad in ("nuke:a.0@1", "kill@5", "kill:a.0", "kill:a.0@0"):
+            with pytest.raises(ValueError):
+                parse_fault_spec(bad)
+
+    def test_env_var_overrides(self, monkeypatch):
+        monkeypatch.setenv("FLINK_TPU_FAULTS", "kill:x.0@7")
+        plan = FaultPlan.resolve(None)
+        assert plan.specs[0] == FaultSpec("kill", "x", 0, 7)
+
+    def test_epoch_filtering(self):
+        from flink_tensorflow_tpu.core.faults import FaultInjector
+
+        plan = FaultPlan.parse("kill:a.0@1#0;kill:a.0@1#1")
+        assert FaultInjector(plan, epoch=0).active
+        inj1 = FaultInjector(plan, epoch=1)
+        assert inj1.active
+        assert not FaultInjector(plan, epoch=2).active
+        # epoch-1 injector fires exactly the epoch-1 spec.
+        with pytest.raises(Exception):
+            inj1.record_point("a.0", 1)
+        assert inj1.fired == [("kill", "a.0", 1)]
+
+
+class TestKillRecovery:
+    def test_source_kill_byte_identical(self, tmp_path):
+        """Kill the source subtask at record 50; the restart strategy
+        restores from the last count-based checkpoint and the committed
+        output is byte-identical to the fault-free run."""
+        _, baseline = run_keyed_job(tmp_path, "baseline")
+        env, out = run_keyed_job(
+            tmp_path, "kill", faults="kill:src.0@50",
+            restart=RestartStrategy(max_restarts=2, delay_s=0.01),
+        )
+        assert committed_bytes(out) == committed_bytes(baseline)
+        rep = env.metric_registry.report()
+        assert rep["recovery.restarts_total"] == 1
+        assert rep["recovery.recovery_duration_s"]["count"] == 1.0
+        assert rep["faults.kill"]["count"] == 1
+
+    def test_keyed_worker_kill_byte_identical(self, tmp_path):
+        """Kill a KEYED subtask mid-stream (its own chain, so the fault
+        targets the worker loop, not a source)."""
+        _, baseline = run_keyed_job(tmp_path, "baseline")
+        _, out = run_keyed_job(
+            tmp_path, "wkill", faults="kill:count.1@25",
+            restart=RestartStrategy(max_restarts=2),
+        )
+        assert committed_bytes(out) == committed_bytes(baseline)
+
+    def test_unrecovered_kill_fails_the_job(self, tmp_path):
+        with pytest.raises(JobFailure):
+            run_keyed_job(tmp_path, "nokill", faults="kill:src.0@10")
+
+
+class TestStallAndCheckpointAbort:
+    def test_stall_aborts_checkpoint_then_later_succeeds(self, tmp_path):
+        """A stalled operator wedges barrier alignment past the
+        checkpoint deadline: the coordinator declines the expired
+        checkpoint (sources keep triggering), and once the stall clears
+        a LATER checkpoint completes and lands on disk."""
+        from flink_tensorflow_tpu.checkpoint.store import latest_checkpoint_id
+
+        _, baseline = run_keyed_job(tmp_path, "baseline")
+        out = str(tmp_path / "out-stall")
+        env = StreamExecutionEnvironment(parallelism=2)
+        env.enable_checkpointing(str(tmp_path / "chk-stall"),
+                                 every_n_records=10)
+        env.configure(
+            checkpoint=dataclasses.replace(env.config.checkpoint,
+                                           timeout_s=0.25),
+            faults="stall:count.0@20~0.6",
+        )
+        # Pace the source PAST the stall window so checkpoints keep
+        # triggering after the wedge clears — the ones cut during the
+        # stall abort, the later ones must complete.
+        env.source_throttle_s = 0.012
+        (
+            env.from_collection(list(range(120)), name="src")
+            .key_by(lambda x: x % NUM_KEYS)
+            .process(KeyedSum(), name="count", parallelism=2)
+            .add_sink(ExactlyOnceRecordFileSink(out), name="sink",
+                      parallelism=1)
+        )
+        handle = env.execute_async("faults-stall")
+        handle.wait(120)
+        coordinator = handle.executor.coordinator
+        rep = env.metric_registry.report()
+        assert rep["recovery.checkpoints_aborted"] >= 1
+        assert coordinator.aborted_ids
+        assert rep["faults.stall"]["count"] == 1
+        # The stream survived the abort with nothing lost or duplicated.
+        assert committed_bytes(out) == committed_bytes(baseline)
+        # A checkpoint NEWER than every aborted id completed durably —
+        # the abort declined ONE snapshot, it did not stop checkpointing.
+        latest = latest_checkpoint_id(str(tmp_path / "chk-stall"))
+        assert latest is not None
+        assert latest > min(coordinator.aborted_ids)
+
+
+class TestStoreFailure:
+    def test_store_write_failure_declines_checkpoint(self, tmp_path):
+        """Checkpoint 2's store write fails: it must be declined (absent
+        on disk, no 2PC commit), a later checkpoint must commit, and the
+        committed output stays byte-identical."""
+        from flink_tensorflow_tpu.checkpoint.store import checkpoint_ids
+
+        _, baseline = run_keyed_job(tmp_path, "baseline")
+        env, out = run_keyed_job(
+            tmp_path, "store", faults="store_fail@2", every=15,
+        )
+        assert committed_bytes(out) == committed_bytes(baseline)
+        ids = checkpoint_ids(str(tmp_path / "chk-store"))
+        assert 2 not in ids
+        assert any(i > 2 for i in ids)
+        rep = env.metric_registry.report()
+        assert rep["faults.store_fail"]["count"] == 1
+        assert rep["recovery.checkpoints_aborted"] >= 1
+
+
+class TestSeverRecovery:
+    def _pipe(self, tmp_path, tag, faults=None):
+        """Producer job (RemoteSink, per-record flush) -> consumer job
+        (RemoteSource -> 2PC sink) in a thread; returns the consumer's
+        committed dir."""
+        from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+
+        out = str(tmp_path / f"pipe-{tag}")
+        source = RemoteSource(bind="127.0.0.1")
+        errors = []
+
+        def consume():
+            try:
+                cenv = StreamExecutionEnvironment(parallelism=1)
+                cenv.from_source(source, name="rsrc").add_sink(
+                    ExactlyOnceRecordFileSink(out), name="csink")
+                cenv.execute(f"consumer-{tag}", timeout=60)
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        env = StreamExecutionEnvironment(parallelism=1)
+        if faults:
+            env.configure(faults=faults)
+        (
+            env.from_collection(list(range(50)), name="src")
+            .map(lambda v: TensorValue({"v": np.int64(v)}, {"i": int(v)}),
+                 name="tv")
+            .add_sink(RemoteSink("127.0.0.1", source.port, flush_bytes=0),
+                      name="rsink")
+        )
+        env.execute(f"producer-{tag}", timeout=60)
+        t.join(60)
+        assert not errors, errors
+        return env, out
+
+    def test_severed_pipe_reconnects_loss_free(self, tmp_path):
+        """Sever the RemoteSink edge at its 3rd frame: the sink's
+        exponential-backoff reconnect resends the in-flight burst, the
+        source holds the fan-in slot open, and the committed output is
+        byte-identical to the fault-free pipe."""
+        _, baseline = self._pipe(tmp_path, "baseline")
+        env, out = self._pipe(tmp_path, "sever", faults="sever:rsink.0@3")
+        assert committed_bytes(out) == committed_bytes(baseline)
+        rep = env.metric_registry.report()
+        assert rep["rsink.0.reconnects"] == 1
+        assert rep["faults.sever"]["count"] == 1
+        assert rep["recovery.edge_reconnects"]["count"] == 1
+
+
+class TestEpochFence:
+    def test_zombie_frames_dropped(self):
+        """A sender handshaking with an older restart epoch is fenced:
+        its records AND its EndOfPartition never reach the gate, its
+        disconnect is not an error, and the drops are counted."""
+        from flink_tensorflow_tpu.core import elements as el
+        from flink_tensorflow_tpu.core.channels import InputGate
+        from flink_tensorflow_tpu.core.shuffle import (
+            RemoteChannelWriter,
+            ShuffleServer,
+        )
+        from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+
+        reg = MetricRegistry()
+        errors = []
+        server = ShuffleServer("127.0.0.1", 0, on_error=errors.append,
+                               metrics=reg, epoch=2)
+        gate = InputGate(1, capacity=64)
+        server.register_gate("sum", 0, gate)
+        server.start()
+        try:
+            zombie = RemoteChannelWriter("127.0.0.1", server.port, "sum",
+                                         0, 0, epoch=1, flush_bytes=0)
+            for i in range(5):
+                zombie.write(el.StreamRecord(("zombie", i), None))
+            zombie.write(el.EndOfPartition())
+            zombie.close()
+            live = RemoteChannelWriter("127.0.0.1", server.port, "sum",
+                                       0, 0, epoch=2, flush_bytes=0)
+            for i in range(3):
+                live.write(el.StreamRecord(("live", i), None))
+            live.write(el.EndOfPartition())
+            live.close()
+            got = []
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(got) < 4:
+                item = gate.poll(timeout=0.2)
+                if item is not None:
+                    got.append(item[1])
+        finally:
+            time.sleep(0.2)
+            server.close()
+        values = [e.value for e in got if isinstance(e, el.StreamRecord)]
+        assert values == [("live", 0), ("live", 1), ("live", 2)]
+        assert reg.report()["recovery.stale_epoch_frames"] >= 6
+        assert not errors
+
+    def test_same_epoch_not_fenced(self):
+        from flink_tensorflow_tpu.core import elements as el
+        from flink_tensorflow_tpu.core.channels import InputGate
+        from flink_tensorflow_tpu.core.shuffle import (
+            RemoteChannelWriter,
+            ShuffleServer,
+        )
+
+        server = ShuffleServer("127.0.0.1", 0, epoch=3)
+        gate = InputGate(1, capacity=16)
+        server.register_gate("t", 0, gate)
+        server.start()
+        try:
+            w = RemoteChannelWriter("127.0.0.1", server.port, "t", 0, 0,
+                                    epoch=3, flush_bytes=0)
+            w.write(el.StreamRecord("x", None))
+            w.write(el.EndOfPartition())
+            w.close()
+            item = gate.poll(timeout=5.0)
+            assert item is not None and item[1].value == "x"
+        finally:
+            server.close()
+
+
+class TestRestartBackoff:
+    def test_exponential_schedule_with_cap(self):
+        rs = RestartStrategy(delay_s=0.1, backoff_multiplier=2.0,
+                             max_delay_s=0.5)
+        assert [round(rs.delay_for(k), 3) for k in (1, 2, 3, 4, 5)] == [
+            0.1, 0.2, 0.4, 0.5, 0.5]
+
+    def test_fixed_delay_default_unchanged(self):
+        rs = RestartStrategy(delay_s=0.25)
+        assert [rs.delay_for(k) for k in (1, 2, 3)] == [0.25, 0.25, 0.25]
+
+    def test_jitter_is_bounded_and_deterministic(self):
+        rs = RestartStrategy(delay_s=1.0, backoff_multiplier=1.0,
+                             jitter=0.2)
+        d1 = rs.delay_for(1, seed=7)
+        assert d1 == rs.delay_for(1, seed=7)  # deterministic
+        assert d1 != rs.delay_for(2, seed=7)  # decorrelated per attempt
+        for k in range(1, 6):
+            assert 0.8 <= rs.delay_for(k, seed=7) <= 1.2
+
+
+class TestHeartbeatDeathDetection:
+    def test_silent_peer_fails_fast(self, tmp_path):
+        """A 2-process cohort whose peer NEVER comes up: with heartbeats
+        on, process 0 fails with CohortPeerLost right after the
+        first-contact grace — instead of wedging until join() times out."""
+        from flink_tensorflow_tpu.core.distributed import DistributedConfig
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            my_port = s.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.set_distributed(DistributedConfig(
+            0, 2, (f"127.0.0.1:{my_port}", f"127.0.0.1:{dead_port}"),
+            connect_timeout_s=0.5, heartbeat_timeout_s=0.4,
+            telemetry_interval_s=0.0,
+        ))
+        # Par-1 pipeline: every subtask lands on process 0, so no record
+        # -plane connect ever touches the dead peer — the HEARTBEAT is
+        # the only thing that can notice it (the hung-peer shape).  The
+        # throttled source outlives the first-contact grace.
+        env.source_throttle_s = 0.05
+        (
+            env.from_collection(list(range(60)), name="src")
+            .map(lambda x: x, name="ident")
+            .sink_to_list()
+        )
+        t0 = time.monotonic()
+        with pytest.raises(JobFailure, match="cohort peer 1 silent"):
+            env.execute("hb", timeout=30)
+        assert time.monotonic() - t0 < 10.0
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.slow
+class TestCohortChaosSoak:
+    def test_two_process_soak_kill_recovers_byte_identical(self, tmp_path):
+        """Slow 2-process cohort chaos soak: a scheduled kill takes the
+        cohort down mid-stream (the survivor fails fast on peer loss),
+        the cohort restarts at epoch 1 from the latest COMMON checkpoint
+        with the sanitizer on, and the committed output equals the
+        fault-free expectation exactly."""
+        from flink_tensorflow_tpu.parallel import latest_common_checkpoint
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from _distributed_worker import expected_emissions  # noqa: E402
+
+        worker = os.path.join(os.path.dirname(__file__),
+                              "_distributed_worker.py")
+        n, every = 240, 40
+        out = str(tmp_path / "out")
+        chk = str(tmp_path / "chk")
+        chks = [os.path.join(chk, f"proc-{i:05d}") for i in range(2)]
+
+        def spawn(i, ports, extra_env=None, restore_id=-1):
+            cmd = [sys.executable, worker, "--index", str(i),
+                   "--ports", ",".join(map(str, ports)), "--out", out,
+                   "--n", str(n), "--every", str(every),
+                   "--restore-id", str(restore_id),
+                   "--throttle", "0.005", "--chk", chk]
+            env_vars = dict(os.environ)
+            env_vars["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__)),
+                 env_vars.get("PYTHONPATH", "")])
+            env_vars.update(extra_env or {})
+            return subprocess.Popen(cmd, env=env_vars,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+
+        # Round 1: process 0's keyed subtask is scheduled to die at its
+        # 60th record (the FLINK_TPU_FAULTS env var reaches the worker
+        # unchanged — no worker-side support needed; small-int key
+        # groups route to subtask 0, which round-robin places on
+        # process 0).  The peer must notice and fail fast too.
+        ports = _free_ports(2)
+        procs = [
+            spawn(0, ports, {"FLINK_TPU_FAULTS": "kill:keyed_sum.0@60"}),
+            spawn(1, ports),
+        ]
+        rcs = []
+        for p in procs:
+            try:
+                pout, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                pout, _ = p.communicate()
+                raise AssertionError(
+                    f"worker hung:\n{pout.decode(errors='replace')}")
+            rcs.append((p.returncode, pout.decode(errors="replace")))
+        assert rcs[0][0] != 0, "faulted worker should have died"
+        assert rcs[1][0] != 0, f"survivor ignored peer loss:\n{rcs[1][1]}"
+        common = latest_common_checkpoint(chks)
+        assert common is not None, "no common checkpoint before the kill"
+
+        # Round 2: restart the cohort (fresh processes = restart epoch 1
+        # for fencing purposes; the fault env var is gone) from the
+        # latest common checkpoint, sanitizer on.
+        ports2 = _free_ports(2)
+        procs = [
+            spawn(i, ports2, {"FLINK_TPU_SANITIZE": "1"},
+                  restore_id=common)
+            for i in range(2)
+        ]
+        for i, p in enumerate(procs):
+            pout, _ = p.communicate(timeout=180)
+            assert p.returncode == 0, (
+                f"restored worker {i} failed:\n"
+                f"{pout.decode(errors='replace')}")
+        got = sorted(
+            (int(r.meta["key"]), int(r.meta["i"]), int(r["v"]))
+            for r in read_committed(out)
+        )
+        assert got == expected_emissions(n)

@@ -177,6 +177,9 @@ class TestCheckpointDuringSourceLull:
             for r in data:
                 payload = encode_record(r)
                 sock.sendall(struct.pack("<Q", len(payload)) + payload)
+            # End-of-stream marker: completion is explicit (a bare FIN
+            # is reconnect-eligible peer LOSS since the chaos plane).
+            sock.sendall(struct.pack("<Q", 0))
             sock.shutdown(socket.SHUT_WR)
             sock.close()
 

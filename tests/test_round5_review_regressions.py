@@ -469,7 +469,7 @@ class TestBackgroundFetch:
 
         class _StubWriter:
             def __init__(self, host, port, task, sender, channel,
-                         connect_timeout_s):
+                         connect_timeout_s, epoch=0):
                 seen_timeouts.append(connect_timeout_s)
 
             def write(self, payload):
