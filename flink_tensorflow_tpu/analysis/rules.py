@@ -776,6 +776,39 @@ def _cohort_telemetry(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
+@rule("slo-unmonitored", Severity.WARN)
+def _slo_unmonitored(ctx: AnalysisContext, emit: Emit) -> None:
+    """Health/autoscale plane wired to a dead feed.  A cohort plan
+    configures ``JobConfig.health`` (SLO rules, possibly an autoscale
+    actuator) but disables the telemetry service
+    (``telemetry_interval_s=0``): the process-0 evaluator then scores
+    ``merged_snapshot()`` over process 0's registry ONLY.  Per-edge
+    backpressure on peers never trips a rule, and an autoscale decision
+    fires (or fails to fire) on a fraction of the evidence — the loop
+    looks closed but watches one process."""
+    cfg = ctx.config
+    health = getattr(cfg, "health", None) if cfg is not None else None
+    if health is None:
+        return
+    dist = getattr(cfg, "distributed", None)
+    if dist is None or getattr(dist, "num_processes", 1) < 2:
+        return
+    if getattr(dist, "telemetry_interval_s", 2.0) > 0:
+        return
+    autoscale = getattr(health, "autoscale", None)
+    what = ("autoscale actuator" if autoscale is not None
+            else "health evaluation")
+    emit(
+        f"JobConfig.health configures {what} for a "
+        f"{dist.num_processes}-process cohort but "
+        "telemetry_interval_s=0 disables metric pushes: the process-0 "
+        "evaluator scores process 0 only, so peer backpressure never "
+        "breaches and scaling decisions act on partial evidence; set "
+        "DistributedConfig.telemetry_interval_s > 0 (or drop "
+        "JobConfig.health)",
+    )
+
+
 @rule("serving-unkeyed-input", Severity.ERROR)
 def _serving_unkeyed_input(ctx: AnalysisContext, emit: Emit) -> None:
     """The continuous-batching operator keys EVERYTHING on the session

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import typing
 
+from flink_tensorflow_tpu.metrics.health import HealthConfig
 from flink_tensorflow_tpu.metrics.reporters import MetricConfig
 
 
@@ -210,6 +211,15 @@ class JobConfig:
     #: while the job runs — no reporter thread, metrics only in the
     #: JobResult.
     metrics: MetricConfig = dataclasses.field(default_factory=MetricConfig)
+    #: Health evaluation plane (metrics.health.HealthConfig): SLO rules
+    #: evaluated over the (merged cohort) metric snapshot each telemetry
+    #: interval on process 0, published back as ``health.*`` gauges,
+    #: flight events, and trace instants.  With
+    #: ``health.autoscale`` (core.autoscale.AutoscaleConfig) a sustained
+    #: BREACH of a scaling rule additionally drives the
+    #: checkpoint->stop->respawn-at-new-parallelism->rescale-restore
+    #: loop.  None (the default) starts no evaluator thread.
+    health: typing.Optional[HealthConfig] = None
 
     def validate(self) -> "JobConfig":
         if self.parallelism < 1:
@@ -264,4 +274,6 @@ class JobConfig:
                 )
         self.metrics.validate()
         self.checkpoint.validate()
+        if self.health is not None:
+            self.health.validate()
         return self

@@ -69,11 +69,14 @@ class RestartStrategy:
 class JobHandle:
     """Handle to an asynchronously running job."""
 
-    def __init__(self, executor: LocalExecutor, reporter=None):
+    def __init__(self, executor: LocalExecutor, reporter=None, health=None):
         self.executor = executor
         #: metrics.reporters.ReporterThread when the job runs with a
         #: report interval; None otherwise (no thread ever started).
         self.reporter = reporter
+        #: metrics.health.HealthEvaluator when JobConfig.health is set
+        #: (process 0 only); None otherwise (no thread ever started).
+        self.health = health
         #: tracing.flight.ShutdownFlusher installed by execute_async so
         #: SIGTERM/SIGINT flush the reporter + flight recorder + trace
         #: before the process dies; uninstalled at wait()/cancel().
@@ -95,6 +98,8 @@ class JobHandle:
             # exactly what the failure post-mortem needs).
             if self._flusher is not None:
                 self._flusher.uninstall()
+            if self.health is not None:
+                self.health.stop()
             if self.reporter is not None:
                 self.reporter.stop()
             self._export_trace()
@@ -124,11 +129,21 @@ class JobHandle:
         self.executor.coordinator.wait_for_persistence(60.0)
         if self._flusher is not None:
             self._flusher.uninstall()
+        if self.health is not None:
+            self.health.stop()
         if self.reporter is not None:
             self.reporter.stop()
         # A cancelled worker keeps its black box, same as a killed one.
         self.executor.flight_dump("cancel")
         self._export_trace()
+
+    @property
+    def autoscale_decision(self):
+        """The AutoscaleDecision this process made (None without one) —
+        a cohort worker checks this after ``wait()`` and exits with the
+        rescale code so its supervisor respawns the cohort resized."""
+        actuator = getattr(self.executor, "autoscale_actuator", None)
+        return actuator.decision if actuator is not None else None
 
     @property
     def metrics(self) -> MetricRegistry:
@@ -577,10 +592,13 @@ class StreamExecutionEnvironment:
             # that explains a failure is published the moment the first
             # subtask dies, not only at the clean-join final report.
             executor.failure_listeners.append(reporter.flush_now)
+        health = self._make_health(executor)
         executor.start()
         if reporter is not None:
             reporter.start()
-        handle = JobHandle(executor, reporter)
+        if health is not None:
+            health.start()
+        handle = JobHandle(executor, reporter, health=health)
         # Graceful-shutdown flush: SIGTERM/SIGINT publish the final
         # reporter snapshot, dump the flight ring, and export the trace
         # BEFORE the previous handler (usually: death) runs — a killed
@@ -600,6 +618,62 @@ class StreamExecutionEnvironment:
             if flusher.install():
                 handle._flusher = flusher
         return handle
+
+    def _make_health(self, executor):
+        """Build (without starting) the health plane, or None.
+
+        The evaluator runs on process 0 only (the cohort's JobManager
+        seat): its feed is the ``CohortCollector.merged_snapshot`` on a
+        distributed executor, the local registry snapshot otherwise —
+        same shape either way.  With ``health.autoscale`` the actuator
+        subscribes level-triggered; its default ``on_decision`` cancels
+        the job so a cohort worker can exit with the rescale code
+        (``JobHandle.autoscale_decision`` tells it to).
+        """
+        cfg = self.config
+        if cfg.health is None:
+            return None
+        dist = cfg.distributed
+        if dist is not None and dist.process_index != 0:
+            return None  # peers push metrics; process 0 evaluates
+        from flink_tensorflow_tpu.metrics.health import HealthEvaluator
+
+        collector = getattr(executor, "cohort_collector", None)
+        if collector is not None:
+            snapshot_fn = collector.merged_snapshot
+        else:
+            registry = self.metric_registry
+            snapshot_fn = lambda: (time.time(), registry.snapshot())  # noqa: E731
+        interval = cfg.health.interval_s
+        if interval is None:
+            telemetry = getattr(dist, "telemetry_interval_s", 0) if dist else 0
+            interval = telemetry if telemetry and telemetry > 0 else 1.0
+        health = HealthEvaluator(
+            cfg.health.resolved_rules(cfg.channel_capacity),
+            interval_s=interval,
+            snapshot_fn=snapshot_fn,
+            registry=self.metric_registry,
+            flight=executor.flight,
+            tracer=executor.tracer,
+        )
+        executor.health_evaluator = health
+        if cfg.health.autoscale is not None:
+            from flink_tensorflow_tpu.core.autoscale import (
+                AutoscaleActuator,
+                checkpoint_gate,
+            )
+
+            actuator = AutoscaleActuator(
+                cfg.health.autoscale,
+                dist.num_processes if dist is not None else 1,
+                checkpoint_ready=checkpoint_gate(
+                    executor.coordinator.checkpoint_dir),
+                on_decision=lambda _d: executor.cancel(),
+                flight=executor.flight,
+            )
+            health.subscribe_ticks(actuator.on_tick)
+            executor.autoscale_actuator = actuator
+        return health
 
     def _make_reporter(self, report_interval_s: typing.Optional[float],
                        flight=None):

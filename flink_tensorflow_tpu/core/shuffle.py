@@ -500,14 +500,26 @@ class _ServerRoute:
             return
         if not self.conn.closed:
             self.conn._do_resume()
+            if self.pending:
+                # Ring routes can stall through _ring_poll's plain
+                # _drain() — backlog held here with the connection never
+                # paused, so _do_resume above was a no-op.  Deliver now:
+                # this wakeup is the only one this gate edge fires (the
+                # queue won't refill while the producer idles), and the
+                # ring poller skips an empty ring.
+                self._drain()
             return
         if self._drain():
             self._finish()
 
     def _ring_poll(self) -> None:
         """Reactor poller (ring routes only): drain frames whose
-        doorbell was lost to the park/publish race."""
-        if self.done or self.ring is None or not self.ring.readable():
+        doorbell was lost to the park/publish race, and finish
+        delivering a backlog stranded by a full gate (the stall may
+        have happened outside on_message, with the connection never
+        paused — the space-listener resume is then a no-op)."""
+        if self.done or self.ring is None or (
+                not self.ring.readable() and not self.pending):
             return
         self.ring.set_consumer_parked(False)
         if self.conn.closed:

@@ -46,6 +46,15 @@ _SUM_SUFFIXES = ("_s", "_bytes", "_depth", "_puts", "_count", "_paused")
 _SUM_NAMES = frozenset({
     "queue_depth", "violations", "tracked_ops", "connections",
     "splits_assigned", "splits_completed",
+    # Serving scheduler (PR 10): cumulative event counts and in-flight
+    # load published as gauges — cohort totals, like their counter kin.
+    "admitted", "evicted", "preempted", "rejected", "serving_steps",
+    "active_seqs", "waiting_seqs", "tokens_in_use",
+    "cache_h2d_blocks", "cache_d2h_blocks", "cache_resident_moves",
+    "dispatches",
+    # Chaos/recovery planes (PR 11): per-process abort lists and fault
+    # injections add up to the cohort's churn.
+    "checkpoints_aborted", "fired_total",
 })
 _LAST_NAMES = frozenset({
     "chain_length", "chained_edges", "chain_position", "current_split_id",
@@ -54,7 +63,19 @@ _LAST_NAMES = frozenset({
 #: time: the cohort-wide value is the WORST process, not the sum.
 _MAX_NAMES = frozenset({
     "poll_to_dispatch_s", "max_poll_to_dispatch_s",
+    # Ages/lags sampled per subtask: the cohort answer is the most
+    # stale process, never the sum of ages.
+    "watermark_lag_s", "current_split_age_s",
+    # The checkpoint scope collides across every process; the cohort's
+    # "latest completed" is the highest id any process reports (a peer
+    # mid-restore may briefly trail).
+    "last_checkpoint_id",
 })
+# Not in any table by design: per-edge "reconnects" and recovery's
+# "restarts_total"/"edge_reconnects" are counters/meters (they sum
+# structurally); serving "ttft_s" is a histogram (reservoir merge);
+# the process-0-only "health" scope never collides, and its default
+# max would be the worst state anyway.
 
 
 def gauge_policy(name: str) -> str:

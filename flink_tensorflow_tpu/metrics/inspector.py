@@ -171,6 +171,11 @@ def build_live_rows(snapshot: typing.Dict[str, typing.Dict[str, typing.Any]]) ->
     window cadence), so each frame shows current throughput, not the
     lifetime average."""
     rows: typing.List[Row] = []
+    # Health plane (metrics/health.py): the process-0 evaluator
+    # publishes per-operator verdicts as numeric gauges under the
+    # "health" scope — merged snapshots carry them for free, so the
+    # live table's health column needs no extra plumbing.
+    health = snapshot.get("health") or {}
     for scope in sorted(snapshot):
         task, index = _split_scope(scope)
         if index is None or task in _JOB_SCOPES:
@@ -181,6 +186,7 @@ def build_live_rows(snapshot: typing.Dict[str, typing.Dict[str, typing.Any]]) ->
         rows.append({
             "operator": task,
             "subtask": index,
+            "health": _health_name(health.get(task)),
             "records_in": rec_in.get("count", 0),
             "in_per_s": _finite(rec_in.get("window_rate")),
             "out_per_s": _finite(rec_out.get("window_rate")),
@@ -194,9 +200,27 @@ def build_live_rows(snapshot: typing.Dict[str, typing.Dict[str, typing.Any]]) ->
     return rows
 
 
+def _health_name(state: typing.Any) -> typing.Optional[str]:
+    """``health`` scope gauge value -> "OK"/"WARN"/"BREACH" (None when
+    no evaluator published a verdict for the operator)."""
+    if isinstance(state, bool) or not isinstance(state, (int, float)):
+        return None
+    from flink_tensorflow_tpu.metrics.health import STATE_NAMES
+
+    idx = int(state)
+    if 0 <= idx < len(STATE_NAMES):
+        return STATE_NAMES[idx]
+    return None
+
+
 def format_live_table(rows: typing.Sequence[Row]) -> str:
+    # The health column only appears when some row carries a verdict —
+    # jobs without JobConfig.health keep the pre-health layout.
+    with_health = any(r.get("health") is not None for r in rows)
     header = ["operator", "in", "in/s", "out/s", "queue", "q.hwm",
               "bp s", "idle s", "wm lag s"]
+    if with_health:
+        header.append("health")
     body = [[
         f"{r['operator']}.{r['subtask']}",
         _fmt(r["records_in"]),
@@ -207,7 +231,8 @@ def format_live_table(rows: typing.Sequence[Row]) -> str:
         _fmt(r["backpressure_s"], digits=2),
         _fmt(r["idle_s"], digits=2),
         _fmt(r["watermark_lag_s"], digits=3),
-    ] for r in rows]
+    ] + ([r.get("health") or "-"] if with_health else [])
+        for r in rows]
     widths = [max(len(h), *(len(b[i]) for b in body)) if body else len(h)
               for i, h in enumerate(header)]
     lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths))]

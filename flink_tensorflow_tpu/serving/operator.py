@@ -65,10 +65,11 @@ class _Session:
     keyed-state form is built at barrier sync)."""
 
     __slots__ = ("seq", "prompt", "max_new", "eos", "status", "generated",
-                 "emitted", "kv", "meta")
+                 "emitted", "kv", "meta", "arrived")
 
     def __init__(self, seq, prompt, max_new, eos, meta,
-                 status=WAITING, generated=(), emitted=0, kv=None):
+                 status=WAITING, generated=(), emitted=0, kv=None,
+                 arrived=None):
         self.seq = seq
         self.prompt = prompt
         self.max_new = max_new
@@ -78,6 +79,10 @@ class _Session:
         self.emitted = emitted
         self.kv = kv
         self.meta = meta
+        # Arrival stamp (monotonic) for the TTFT histogram; None for
+        # sessions thawed from keyed state — a restored session's
+        # first-token latency is recovery time, not serving TTFT.
+        self.arrived = arrived
 
     def freeze(self) -> SessionState:
         return SessionState(
@@ -118,6 +123,7 @@ class ContinuousBatchingOperator(Operator):
         self._sessions: typing.Dict[typing.Any, _Session] = {}
         self._seq = 0
         self._grp = None
+        self._ttft = None
         self._restored_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
@@ -165,6 +171,10 @@ class ContinuousBatchingOperator(Operator):
             grp.gauge("cache_d2h_blocks", lambda r=runner: r.block_d2h_events)
             grp.gauge("cache_resident_moves",
                       lambda r=runner: r.device_block_moves)
+            # Time-to-first-token: request admission -> first generated
+            # token emitted.  The health plane's serving-ttft rule reads
+            # this histogram's p95 off the merged cohort snapshot.
+            self._ttft = grp.histogram("ttft_s")
         # Failover/rescale rebuild: sessions restored into keyed state
         # re-enter the waiting queue in arrival order; their KV blocks
         # (synced at the snapshot barrier) re-admit without re-prefill.
@@ -221,7 +231,7 @@ class ContinuousBatchingOperator(Operator):
         self._seq += 1
         self._sessions[key] = _Session(
             self._seq, req.prompt, req.max_new_tokens, req.eos_token,
-            dict(req.meta))
+            dict(req.meta), arrived=time.monotonic())
         self._sched.enqueue(key)
 
     # -- timer-driven step loop -------------------------------------------
@@ -260,6 +270,10 @@ class ContinuousBatchingOperator(Operator):
                       finished: bool) -> None:
         index = len(sess.generated)
         sess.generated.append(token)
+        if index == 0 and sess.arrived is not None:
+            if self._ttft is not None:
+                self._ttft.record(time.monotonic() - sess.arrived)
+            sess.arrived = None
         if index >= sess.emitted:
             self.output.emit(TokenEvent(
                 session_id=key, index=index, token=token,

@@ -855,3 +855,69 @@ class TestExactlyOnceBoundaryLint:
             assert by_rule(analyze(env.graph), "exactly-once-boundary") == []
         finally:
             src.close()
+
+
+class TestSloUnmonitoredLint:
+    """slo-unmonitored: JobConfig.health over a cohort whose telemetry
+    service is off — the evaluator/actuator would watch process 0 only."""
+
+    @staticmethod
+    def _dist(telemetry_interval_s):
+        from flink_tensorflow_tpu.core.distributed import DistributedConfig
+
+        return DistributedConfig(
+            0, 2, ("127.0.0.1:9001", "127.0.0.1:9002"),
+            telemetry_interval_s=telemetry_interval_s)
+
+    @staticmethod
+    def _health(autoscale=False):
+        from flink_tensorflow_tpu.core.autoscale import AutoscaleConfig
+        from flink_tensorflow_tpu.metrics.health import HealthConfig
+
+        return HealthConfig(
+            autoscale=AutoscaleConfig() if autoscale else None)
+
+    def _env(self, *, health=None, dist=None):
+        env = clean_env()
+        if health is not None:
+            env.configure(health=health)
+        if dist is not None:
+            env.set_distributed(dist)
+        return env
+
+    def test_warns_health_on_dead_cohort_feed(self):
+        env = self._env(health=self._health(), dist=self._dist(0.0))
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "slo-unmonitored")
+        assert len(diags) == 1
+        assert diags[0].severity == Severity.WARN
+        assert "health evaluation" in diags[0].message
+        assert "telemetry_interval_s" in diags[0].message
+
+    def test_warn_names_the_actuator_when_autoscale_set(self):
+        env = self._env(health=self._health(autoscale=True),
+                        dist=self._dist(0.0))
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "slo-unmonitored")
+        assert len(diags) == 1
+        assert "autoscale actuator" in diags[0].message
+
+    def test_clean_when_telemetry_enabled(self):
+        env = self._env(health=self._health(autoscale=True),
+                        dist=self._dist(2.0))
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "slo-unmonitored") == []
+
+    def test_clean_single_process(self):
+        env = self._env(health=self._health())
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "slo-unmonitored") == []
+
+    def test_clean_without_health(self):
+        env = self._env(dist=self._dist(0.0))
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "slo-unmonitored") == []
+
+    def test_bare_graph_without_config_skips(self):
+        env = self._env(health=self._health(), dist=self._dist(0.0))
+        assert by_rule(analyze(env.graph), "slo-unmonitored") == []

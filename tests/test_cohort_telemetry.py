@@ -142,6 +142,27 @@ class TestMergeSemantics:
         assert gauge_policy("poll_to_dispatch_s") == "max"
         assert gauge_policy("max_poll_to_dispatch_s") == "max"
 
+    def test_gauge_policy_covers_post_pr9_names(self):
+        # Serving scheduler: cumulative events and in-flight load sum
+        # to cohort totals.
+        for name in ("admitted", "evicted", "preempted", "rejected",
+                     "serving_steps", "active_seqs", "waiting_seqs",
+                     "tokens_in_use", "cache_h2d_blocks",
+                     "cache_d2h_blocks", "cache_resident_moves",
+                     "step_h2d_bytes", "dispatches"):
+            assert gauge_policy(name) == "sum", name
+        # Recovery/chaos planes: per-process churn adds up.
+        assert gauge_policy("checkpoints_aborted") == "sum"
+        assert gauge_policy("fired_total") == "sum"
+        # Ages/lags are levels — worst process, despite the _s suffix.
+        assert gauge_policy("watermark_lag_s") == "max"
+        assert gauge_policy("current_split_age_s") == "max"
+        # Checkpoint scope collides across the whole cohort: the
+        # latest completed id is the highest any process reports, while
+        # shard sizes sum to the cohort's checkpoint footprint.
+        assert gauge_policy("last_checkpoint_id") == "max"
+        assert gauge_policy("last_size_bytes") == "sum"
+
     def test_meters_and_counters_sum_across_processes(self):
         a = _registry_with("wire", records=10).export_state()
         b = _registry_with("wire", records=32).export_state()
