@@ -3175,6 +3175,216 @@ def bench_autoscale(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# workload 12: overload survival — credit flow control on vs off (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+#: Full overload detail (both arms + trace attribution) lands here.
+BENCH_R12_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_r12.json")
+
+
+def bench_overload(args) -> dict:
+    """Overload survival (ISSUE 14): an unthrottled producer drives a
+    remote record-plane edge into an artificially slow consumer (fixed
+    per-record service time plus one hard mid-stream stall), once with
+    credit flow control ON and once OFF.  Everything else — payload,
+    coalescing knobs, gate capacity, stall schedule — is shared, so
+    every delta is the credit window.  Books the sender's RSS proxy
+    (``peak_send_queue_bytes``, the reactor out-queue high-water mark:
+    with credits it is capped at window x frame quantum, without them
+    it grows with however far the producer ran ahead), end-to-end
+    throughput, stall-recovery latency (consumer resumes -> sender
+    backlog drained), and the before/after per-stage trace attribution
+    (the ON arm's park shows up as ``wire.credit_wait`` spans, the OFF
+    arm's pile-up as inflated ``wire`` time) into BENCH_r12.json."""
+    import threading
+
+    from flink_tensorflow_tpu.core import elements as el
+    from flink_tensorflow_tpu.core.channels import InputGate
+    from flink_tensorflow_tpu.core.reactor import Reactor
+    from flink_tensorflow_tpu.core.shuffle import (
+        CREDIT_OVERFLOW_FRAMES,
+        RemoteChannelWriter,
+        ShuffleServer,
+        credit_window,
+    )
+    from flink_tensorflow_tpu.metrics.registry import MetricRegistry
+    from flink_tensorflow_tpu.tensors import TensorValue
+    from flink_tensorflow_tpu.tracing.attribution import attribution
+    from flink_tensorflow_tpu.tracing.tracer import Tracer
+
+    n = args.records or (400 if args.smoke else 2000)
+    payload = 256              # floats per record (~1KB on the wire)
+    capacity = 64              # gate quanta -> credit window of 2
+    flush_bytes = 4096
+    flush_ms = 2.0
+    service_s = 0.0002         # consumer ceiling ~5k records/s
+    stall_at = max(1, n // 3)
+    stall_s = 0.3 if args.smoke else 0.5
+    window = credit_window(capacity)
+
+    def stage_table(events):
+        merged: dict = {}
+        for rows in attribution(events).values():
+            for stage, row in rows.items():
+                if stage not in ("serde", "wire", "wire.flush",
+                                 "wire.credit_wait"):
+                    continue
+                agg = merged.setdefault(
+                    stage, {"count": 0, "total_ms": 0.0, "p50s": []})
+                agg["count"] += row["count"]
+                agg["total_ms"] += row["total_ms"]
+                agg["p50s"].append(row["p50_ms"])
+        return {
+            stage: {"count": agg["count"],
+                    "total_ms": round(agg["total_ms"], 3),
+                    "p50_ms": round(float(np.median(agg["p50s"])), 4)}
+            for stage, agg in merged.items()
+        }
+
+    def run_arm(flow_control):
+        reg = MetricRegistry()
+        tracer = Tracer(sample_rate=1.0)
+        gate = InputGate(1, capacity=capacity)
+        server = ShuffleServer("127.0.0.1", 0, metrics=reg)
+        server.register_gate("op", 0, gate)
+        server.start()
+        reactor = Reactor()
+        reactor.start()
+        writer = RemoteChannelWriter(
+            "127.0.0.1", server.port, "op", 0, 0, metrics=reg,
+            flush_bytes=flush_bytes, flush_ms=flush_ms, reactor=reactor,
+            tracer=tracer, flow_control=flow_control)
+        got = [0]
+        stall_over_t = [0.0]
+        backlog_drained_t = [0.0]
+        done = threading.Event()
+
+        def consume():
+            while True:
+                item = gate.poll(timeout=1.0)
+                if item is None:
+                    continue
+                element = item[1]
+                if isinstance(element, el.EndOfPartition):
+                    done.set()
+                    return
+                got[0] += 1
+                if got[0] == stall_at:
+                    time.sleep(stall_s)
+                    stall_over_t[0] = time.monotonic()
+                else:
+                    time.sleep(service_s)
+
+        def watch_recovery():
+            # Stall-recovery latency: consumer resumes -> the sender's
+            # reactor backlog is back under one frame quantum.
+            while stall_over_t[0] == 0.0 and not done.is_set():
+                time.sleep(0.005)
+            conn = writer._conn
+            while not done.is_set():
+                if (conn is None
+                        or conn.send_queue_bytes <= flush_bytes):
+                    backlog_drained_t[0] = time.monotonic()
+                    return
+                time.sleep(0.005)
+
+        consumer = threading.Thread(target=consume)
+        consumer.start()
+        watcher = threading.Thread(target=watch_recovery)
+        t0 = time.monotonic()
+        try:
+            rec = np.arange(payload, dtype=np.float32)
+            for i in range(n):
+                writer.write(el.StreamRecord(
+                    TensorValue({"x": rec}, {"i": i}), None))
+                if i == 0:
+                    watcher.start()
+            writer.write(el.EndOfPartition())
+            produced_s = time.monotonic() - t0
+            assert done.wait(300), "consumer never saw EndOfPartition"
+            wall = time.monotonic() - t0
+            conn = writer._conn
+            peak = 0 if conn is None else conn.peak_send_queue_bytes
+        finally:
+            done.set()
+            consumer.join(10)
+            watcher.join(10)
+            writer.close()
+            reactor.close()
+            server.close()
+        rep = reg.report()
+        recovery_s = (backlog_drained_t[0] - stall_over_t[0]
+                      if backlog_drained_t[0] and stall_over_t[0] else None)
+        return {
+            "flow_control": flow_control,
+            "wall_s": round(wall, 3),
+            "producer_wall_s": round(produced_s, 3),
+            "records_per_s": round(n / wall, 1),
+            "peak_send_queue_bytes": int(peak),
+            "stall_recovery_s": (None if recovery_s is None
+                                 else round(max(0.0, recovery_s), 4)),
+            "credit_starved_s": round(
+                rep.get("shuffle.out.op.0.ch0.credit_starved_s", 0.0), 4),
+            "credit_grants": rep.get("shuffle.in.op.0.ch0.credit_grants", 0),
+            "records_delivered": got[0],
+            "trace_attribution": stage_table(tracer.events()),
+        }
+
+    on = run_arm(True)
+    off = run_arm(False)
+    credit_bound = (window + CREDIT_OVERFLOW_FRAMES) * (flush_bytes + 4096)
+    detail = {
+        "kind": "overload-credit-flow-control",
+        "records": n,
+        "payload_floats": payload,
+        "gate_capacity": capacity,
+        "credit_window": window,
+        "flush_bytes": flush_bytes,
+        "stall": {"at_record": stall_at, "duration_s": stall_s},
+        "consumer_service_s": service_s,
+        "credit_bound_bytes": credit_bound,
+        "credits_on": on,
+        "credits_off": off,
+    }
+    try:
+        tmp = BENCH_R12_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(detail), f, allow_nan=False, indent=1)
+        os.replace(tmp, BENCH_R12_PATH)
+        booked = "BENCH_r12.json"
+    except OSError:
+        booked = None
+    return {
+        "metric": "overload_peak_send_queue_bytes_on",
+        "value": on["peak_send_queue_bytes"],
+        "unit": "bytes",
+        "vs_baseline": None,
+        "records": n,
+        "credit_window": window,
+        "credit_bound_bytes": credit_bound,
+        "peak_bounded_by_window": on["peak_send_queue_bytes"] <= credit_bound,
+        "off_over_on_peak_ratio": (
+            None if not on["peak_send_queue_bytes"] else round(
+                off["peak_send_queue_bytes"] / on["peak_send_queue_bytes"],
+                2)),
+        "throughput_on_off": [on["records_per_s"], off["records_per_s"]],
+        "stall_recovery_s_on_off": [on["stall_recovery_s"],
+                                    off["stall_recovery_s"]],
+        "lossless_both_arms": (on["records_delivered"] == n
+                               and off["records_delivered"] == n),
+        "credits_on": {k: on[k] for k in
+                       ("credit_starved_s", "credit_grants")},
+        "full_detail": booked,
+        "baseline_note": (
+            "credits-off arm IS the baseline: the pre-credit wire where "
+            "a stalled consumer lets the sender's reactor out-queue "
+            "grow with however far the producer ran ahead; the ON arm "
+            "must cap it at credit window x frame quantum"),
+    }
+
+
 WORKLOADS = {
     "inception": bench_inception,
     "mnist": bench_mnist,
@@ -3187,6 +3397,7 @@ WORKLOADS = {
     "serving": bench_serving,
     "chaos": bench_chaos,
     "autoscale": bench_autoscale,
+    "overload": bench_overload,
 }
 
 #: --workload aliases, resolved before dispatch ("all" never expands

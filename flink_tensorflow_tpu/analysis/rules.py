@@ -669,6 +669,57 @@ def _remote_edge_buffer_timeout(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
+@rule("flow-control", Severity.WARN)
+def _flow_control_disabled(ctx: AnalysisContext, emit: Emit) -> None:
+    """Checkpointed multi-process plan running with credit flow control
+    DISABLED behind an open-loop paced source: the source keeps
+    producing on its arrival schedule regardless of downstream pace, so
+    when a consumer stalls (GC, slow commit, chaos fault) the sender's
+    wire buffers grow without bound — exactly the overload the credit
+    window (``JobConfig.flow_control``, on by default) exists to cap at
+    a constant.  Worse, a checkpointed plan stalls ALIGNMENT behind
+    those unbounded queues: barriers sit at the back of however many
+    frames accumulated, so checkpoint durations creep with load instead
+    of staying constant.  Re-enable flow_control (or close the loop at
+    the source) before trusting this plan under overload."""
+    cfg = ctx.config
+    if cfg is None:
+        return
+    if getattr(cfg, "flow_control", True) is not False:
+        return
+    if getattr(cfg, "distributed", None) is None:
+        return  # single-process: channels are in-memory and bounded
+    checkpoint = getattr(cfg, "checkpoint", None)
+    if checkpoint is None or getattr(checkpoint, "dir", None) is None:
+        return  # no alignment to wedge; overload just slows the job
+    try:
+        from flink_tensorflow_tpu.sources.paced import PacedSplitSource
+    except Exception:  # pragma: no cover - import cycle guard
+        PacedSplitSource = ()  # type: ignore[assignment]
+    for t in ctx.order:
+        if not t.is_source:
+            continue
+        op = ctx.operators.get(t.id)
+        paced = False
+        for attr in ("function", "source"):
+            feed = getattr(op, attr, None)
+            if feed is not None and (
+                    isinstance(feed, PacedSplitSource)
+                    or getattr(feed, "is_open_loop", False)):
+                paced = True
+                break
+        if paced:
+            emit(
+                "open-loop paced source feeds a checkpointed multi-"
+                "process plan with flow_control=False — a stalled "
+                "consumer lets sender queues (and checkpoint alignment "
+                "time) grow without bound; re-enable "
+                "JobConfig.flow_control so a zero-credit edge parks the "
+                "producer within one credit window",
+                node=t.name,
+            )
+
+
 @rule("exactly-once-boundary", Severity.WARN)
 def _exactly_once_boundary(ctx: AnalysisContext, emit: Emit) -> None:
     """Checkpointed plan ingesting through a NON-REPLAYABLE source: a

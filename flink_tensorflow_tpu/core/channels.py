@@ -103,6 +103,16 @@ class InputGate:
         #: reactor connection re-arms event-driven instead of polling.
         #: Listeners must be non-blocking (a reactor wakeup pipe write).
         self._space_listeners: typing.List[typing.Callable[[], None]] = []
+        #: Drain listeners (record-plane flow control): invoked under the
+        #: gate lock when the consuming ``poll`` pulls the queue DOWN
+        #: across the low-water mark (and on close).  The shuffle
+        #: server's routes use this as the gate-drain -> credit-replenish
+        #: hook: grants withheld while the gate sat near-full are issued
+        #: once the consumer demonstrably drains.  Edge-triggered at
+        #: ``capacity // 2`` so a hot consumer costs one callback per
+        #: refill cycle, not one per element.
+        self._drain_listeners: typing.List[typing.Callable[[], None]] = []
+        self._low_water = max(1, capacity // 2)
 
     # -- writer side ---------------------------------------------------
     def put(self, channel_idx: int, element: el.StreamElement) -> float:
@@ -193,6 +203,22 @@ class InputGate:
             except Exception:  # noqa: BLE001 — observer only, never the plane
                 pass
 
+    def add_drain_listener(self, fn: typing.Callable[[], None]) -> None:
+        """Register a callback fired (under the gate lock — it must not
+        block) when the consumer drains the queue below the low-water
+        mark, and on close.  This is the credit-replenish hook: a
+        receiver route that withheld grants against a backed-up gate
+        re-evaluates once the downstream demonstrably consumes."""
+        with self._lock:
+            self._drain_listeners.append(fn)
+
+    def _notify_drain(self) -> None:
+        for fn in self._drain_listeners:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — observer only, never the plane
+                pass
+
     def wake(self) -> None:
         """Break a blocked :meth:`poll` immediately.
 
@@ -242,6 +268,10 @@ class InputGate:
                 if self._space_listeners and len(self._queue) == self.capacity - 1:
                     # full -> not-full transition: wake paused reactors.
                     self._notify_space()
+                if self._drain_listeners and len(self._queue) == self._low_water - 1:
+                    # crossed the low-water mark going DOWN: the consumer
+                    # is keeping up — replenish withheld credits.
+                    self._notify_drain()
                 if idx < 0:
                     self._wake_sentinels -= 1
                     return None  # wake() sentinel: hand control back NOW
@@ -275,6 +305,7 @@ class InputGate:
             # Paused reactor connections must not stay parked on a gate
             # nobody will ever drain again (try_put drops from here on).
             self._notify_space()
+            self._notify_drain()
 
     @property
     def any_blocked(self) -> bool:

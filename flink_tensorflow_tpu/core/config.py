@@ -184,6 +184,21 @@ class JobConfig:
     #: remains as handshake/wakeup/liveness channel.  Cross-host edges
     #: are unaffected.  FLINK_TPU_SHM=0/1 overrides.
     shm_channels: bool = True
+    #: Credit-based flow control on the cross-process record plane
+    #: (Flink's network-stack model): receivers grant per-edge credits
+    #: (buffer quanta derived from ``channel_capacity``) in the shuffle
+    #: handshake and replenish them as the downstream gate drains;
+    #: senders spend one credit per flushed data frame and park when
+    #: credit hits zero — a stalled consumer throttles the producer
+    #: chain within one credit window instead of ballooning reactor
+    #: send queues and kernel TCP buffers.  Barriers, watermarks,
+    #: end-of-partition and 2PC/control announcements BYPASS credit so
+    #: a zero-credit edge can never wedge checkpoint alignment (the
+    #: checkpoint deadline-abort sweeper remains the backstop).
+    #: FLINK_TPU_FLOW_CONTROL=0/1 overrides.  Disabling this on a
+    #: checkpointed multi-process plan behind an open-loop source
+    #: trips the `flow-control` lint.
+    flow_control: bool = True
     #: Deterministic fault-injection plan (core.faults.FaultPlan, a spec
     #: string, or a sequence of FaultSpec/spec strings): scheduled
     #: kill/stall/sever/blackhole/delay/store_fail faults pinned to

@@ -322,6 +322,11 @@ class DistributedExecutor(LocalExecutor):
             epoch=self.dist.restart_epoch,
             fault_hook=(self.faults.edge_hook(t.name, subtask_index)
                         if self.faults is not None else None),
+            # Credit-based flow control (JobConfig.flow_control): the
+            # writer requests a window in the handshake; control-plane
+            # writers (_get_control_writer) stay credit-free — 2PC
+            # announcements and aborts must never park behind data.
+            flow_control=self.flow_control,
         )
         self._remote_writers.append(writer)
         return writer

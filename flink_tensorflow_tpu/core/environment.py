@@ -387,6 +387,7 @@ class StreamExecutionEnvironment:
             wire_flush_bytes=cfg.wire_flush_bytes,
             wire_flush_ms=cfg.wire_flush_ms,
             shm_channels=cfg.shm_channels,
+            flow_control=cfg.flow_control,
             trace=cfg.trace,
             trace_path=cfg.trace_path,
             trace_sample_rate=cfg.trace_sample_rate,

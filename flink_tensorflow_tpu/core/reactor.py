@@ -186,6 +186,7 @@ class Connection:
         self._want_read = parser is not None
         self._out: typing.Deque[memoryview] = collections.deque()
         self._out_bytes = 0
+        self._peak_out_bytes = 0
         self._send_cv = threading.Condition()
         self._closed = False
         self._error: typing.Optional[BaseException] = None
@@ -346,6 +347,8 @@ class Connection:
                 mv = mv.cast("B") if mv.format != "B" or mv.ndim != 1 else mv
                 self._out.append(mv)
                 self._out_bytes += mv.nbytes
+            if self._out_bytes > self._peak_out_bytes:
+                self._peak_out_bytes = self._out_bytes
         self.reactor.submit(self._update_interest)
         if not block:
             return
@@ -395,6 +398,16 @@ class Connection:
     def send_queue_bytes(self) -> int:
         """Bytes pending on the writer-side queue (reactor gauge)."""
         return self._out_bytes
+
+    @property
+    def peak_send_queue_bytes(self) -> int:
+        """High-water mark of the writer-side queue over the
+        connection's lifetime — the sender-side memory (RSS proxy) a
+        slow peer cost at its worst.  The flow-control acceptance bound
+        (queue stays ≤ credit window × frame size under a stalled
+        consumer) and the overload bench read THIS, not the instant
+        depth, so a transient between two polls can't hide growth."""
+        return self._peak_out_bytes
 
     def drain(self, timeout: typing.Optional[float] = None) -> bool:
         """Wait for the send queue to empty; True when drained."""

@@ -713,6 +713,7 @@ class LocalExecutor:
         wire_flush_bytes: typing.Optional[int] = None,
         wire_flush_ms: typing.Optional[float] = None,
         shm_channels: bool = True,
+        flow_control: bool = True,
         faults: typing.Optional[typing.Any] = None,
         restart_epoch: int = 0,
     ):
@@ -744,10 +745,19 @@ class LocalExecutor:
         #: the RuntimeContext and the DistributedExecutor's writers.
         self.wire_flush_bytes = wire_flush_bytes
         self.wire_flush_ms = wire_flush_ms
-        from flink_tensorflow_tpu.core.shuffle import env_shm_enabled
+        from flink_tensorflow_tpu.core.shuffle import (
+            env_flow_control_enabled,
+            env_shm_enabled,
+        )
 
         env_shm = env_shm_enabled()
         self.shm_channels = shm_channels if env_shm is None else env_shm
+        #: Credit-based flow control on the cross-process record plane
+        #: (JobConfig.flow_control; FLINK_TPU_FLOW_CONTROL overrides).
+        #: A LocalExecutor has no remote edges — this only feeds the
+        #: DistributedExecutor's writers and RemoteSink defaults.
+        env_fc = env_flow_control_enabled()
+        self.flow_control = flow_control if env_fc is None else env_fc
         #: Debug-mode concurrency sanitizer (core/sanitizer_rt):
         #: JobConfig.sanitize=True or FLINK_TPU_SANITIZE=1 instruments
         #: every gate/mailbox/coordinator lock and asserts the barrier
@@ -1098,6 +1108,7 @@ class LocalExecutor:
             # at open() when its own knobs are unset).
             ctx.wire_flush_bytes = self.wire_flush_bytes
             ctx.wire_flush_ms = self.wire_flush_ms
+            ctx.flow_control = self.flow_control
             # Chaos-plane hand-off: RemoteSink resolves its per-edge
             # fault hook (sever/blackhole/delay) from this at open().
             ctx.fault_injector = self.faults

@@ -69,6 +69,12 @@ class RuntimeContext:
         #: model runners narrow their h2d transfers with it, remote sinks
         #: their TCP frames.
         self.wire_dtype: typing.Optional[str] = None
+        #: Credit-based flow control on the record plane
+        #: (JobConfig.flow_control): RemoteSink consults it at open() to
+        #: decide whether to request a credit window from its peer
+        #: RemoteSource; the shuffle writers get it from the executor
+        #: directly.
+        self.flow_control: bool = True
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)

@@ -100,6 +100,31 @@ DEFAULT_FLUSH_MS = 5.0
 #: one framing every connection already parses.
 RING_NOTIFY = "__ring_notify__"
 
+#: Credit grant marker (Flink's AddCredit announcement): the receiver
+#: sends ``(CREDIT_GRANT, n)`` frames back over the data socket —
+#: n more data frames may be flushed on this edge.  The initial window
+#: rides the handshake reply; replenishment follows the downstream
+#: gate's drain.  shm edges carry the same grants through a cumulative
+#: counter cell in the ring header instead (no reverse socket traffic).
+CREDIT_GRANT = "__credit__"
+
+#: Alignment overflow budget, in frames: a data flush forced AHEAD of a
+#: barrier / EndOfPartition may overdraw the credit window by this many
+#: frames, so checkpoint alignment can never wedge behind a parked data
+#: frame on a zero-credit edge (the control element itself bypasses
+#: credit entirely; the checkpoint deadline-abort sweeper remains the
+#: backstop when even the overdraft cannot reach a dead peer).
+CREDIT_OVERFLOW_FRAMES = 4
+
+
+def credit_window(channel_capacity: int) -> int:
+    """Per-edge credit window in FRAMES, derived from the receiving
+    gate's element capacity: one credit is one coalesced wire frame
+    (≤ flush_bytes), so the window bounds sender-side queued bytes at
+    ``window × flush_bytes`` while staying deep enough to keep the pipe
+    busy across the grant round-trip."""
+    return max(2, min(32, channel_capacity // 32))
+
 _RING_NOTIFY_WIRE: typing.Optional[bytes] = None
 
 
@@ -125,6 +150,13 @@ def env_flush_ms() -> typing.Optional[float]:
 
 def env_shm_enabled() -> typing.Optional[bool]:
     v = os.environ.get("FLINK_TPU_SHM")
+    if v is None or v == "":
+        return None
+    return v.lower() in ("1", "true", "on", "yes")
+
+
+def env_flow_control_enabled() -> typing.Optional[bool]:
+    v = os.environ.get("FLINK_TPU_FLOW_CONTROL")
     if v is None or v == "":
         return None
     return v.lower() in ("1", "true", "on", "yes")
@@ -357,6 +389,15 @@ class _ServerRoute:
         self.pending: typing.Deque[typing.Any] = collections.deque()
         self.ring: typing.Optional[ShmByteRing] = None
         self._ring_parser = ShuffleFrameParser()
+        #: Credit-based flow control (negotiated in the handshake):
+        #: this route granted an initial window and replenishes one
+        #: credit per data frame once the frame's elements reached the
+        #: gate AND the gate is demonstrably draining.  All state is
+        #: reactor-thread-only.
+        self.fc = False
+        self._fc_window = 0
+        self._fc_unacked = 0
+        self._credit_grants = None
         self.saw_eop = False
         self.eof_clean: typing.Optional[bool] = None  # None = conn still open
         self.done = False
@@ -386,6 +427,12 @@ class _ServerRoute:
             return True
         if obj == RING_NOTIFY:
             return self._drain()
+        if self.fc and not isinstance(
+                obj, (el.CheckpointBarrier, el.Watermark, el.EndOfPartition)):
+            # Mirror of the sender's spend rule: lone control elements
+            # bypass credit on the sender, so they must not earn a
+            # replenishment here either (the books balance exactly).
+            self._fc_unacked += 1
         self._ingest(obj, nbytes)
         return self._drain()
 
@@ -446,6 +493,19 @@ class _ServerRoute:
             # connection (delivery paused, kernel TCP window closing on
             # the peer) ticks once.
             self._gate_paused = group.counter("gate_paused")
+        if opts.get("fc"):
+            # Credit-based flow control (Flink's AddCredit protocol):
+            # the sender asked for a window — grant buffer quanta
+            # derived from this gate's capacity NOW (the handshake
+            # reply) and replenish as the gate drains.  Control routes
+            # and fenced zombies never reach here, so neither can ever
+            # receive (or emit) a grant.
+            self.fc = True
+            self._fc_window = credit_window(gate.capacity)
+            if self.server.metrics is not None:
+                self._credit_grants = group.counter("credit_grants")
+            gate.add_drain_listener(lambda: reactor.submit(self._fc_kick))
+            self._grant(self._fc_window)
         return True
 
     def _ingest(self, obj, nbytes: int) -> None:
@@ -476,6 +536,7 @@ class _ServerRoute:
                         self._gate_paused.inc()
                     return False
             if self.ring is None:
+                self._maybe_grant()
                 return True
             frame = self.ring.read()
             if frame is None:
@@ -486,12 +547,52 @@ class _ServerRoute:
                 self.ring.set_consumer_parked(True)
                 frame = self.ring.read()
                 if frame is None:
+                    self._maybe_grant()
                     return True
                 self.ring.set_consumer_parked(False)
             for obj, nbytes in self._ring_parser.feed(frame):
                 if obj == RING_NOTIFY:
                     continue
+                if self.fc and not isinstance(
+                        obj, (el.CheckpointBarrier, el.Watermark,
+                              el.EndOfPartition)):
+                    self._fc_unacked += 1
                 self._ingest(obj, nbytes)
+
+    # -- flow control (reactor thread) ----------------------------------
+    def _grant(self, n: int) -> None:
+        """Announce ``n`` more frame credits to the sender: over the
+        ring's cumulative credit cell in shm mode (no reverse socket
+        traffic), as a ``(CREDIT_GRANT, n)`` frame on the data socket
+        otherwise.  Non-blocking — a grant frame rides the reactor's
+        send queue (tiny, and the peer always drains its grant lane)."""
+        if self.ring is not None:
+            self.ring.add_credits(n)
+        elif not self.conn.closed:
+            parts, _ = encode_obj_frame((CREDIT_GRANT, n))
+            self.conn.send(parts, block=False)
+        if self._credit_grants is not None:
+            self._credit_grants.inc(n)
+
+    def _maybe_grant(self) -> None:
+        """Replenish credits for frames whose elements all reached the
+        gate — but only while the gate itself is draining (queue below
+        its low-water mark).  Granting into a backed-up gate would just
+        migrate the sender's queue downstream; the gate's drain listener
+        re-enters here the moment the consumer demonstrably consumes."""
+        if not self.fc or self._fc_unacked <= 0 or self.pending or self.done:
+            return
+        gate = self.gate
+        if gate is not None and len(gate._queue) >= gate._low_water:
+            return
+        n, self._fc_unacked = self._fc_unacked, 0
+        self._grant(n)
+
+    def _fc_kick(self) -> None:
+        """Gate-drain wakeup (reactor thread, via the drain listener):
+        issue grants withheld while the gate sat above low water."""
+        if not self.done:
+            self._maybe_grant()
 
     def _kick(self) -> None:
         """Gate-space wakeup (reactor thread): resume a paused
@@ -765,7 +866,8 @@ class RemoteChannelWriter:
                  tracer: typing.Optional[typing.Any] = None,
                  epoch: int = 0,
                  reconnect_timeout_s: float = 5.0,
-                 fault_hook: typing.Optional[typing.Callable[[], typing.Optional[str]]] = None):
+                 fault_hook: typing.Optional[typing.Callable[[], typing.Optional[str]]] = None,
+                 flow_control: bool = False):
         self.host = host
         self.port = port
         self.task = task
@@ -787,6 +889,22 @@ class RemoteChannelWriter:
         #: Chaos plane (core/faults.py): per-frame injection hook —
         #: None (production) costs one is-None test per flush.
         self._fault_hook = fault_hook
+        #: Credit-based flow control (JobConfig.flow_control): request a
+        #: credit window in the handshake and spend one credit per
+        #: flushed DATA frame, parking when the window is exhausted —
+        #: bounded sender-side memory under a stalled consumer.  Control
+        #: elements bypass credit entirely; data flushed ahead of them
+        #: may overdraw by CREDIT_OVERFLOW_FRAMES so alignment never
+        #: wedges.  Requires a reactor (TCP grants arrive on the event
+        #: loop) or the shm ring (grants ride the ring's credit cell);
+        #: blocking/standalone writers stay credit-free.
+        self.flow_control = flow_control
+        self._fc_cv = threading.Condition()
+        self._fc_credits = 0          # TCP grants available (may overdraw)
+        self._fc_ring_spent = 0       # frames spent against the ring cell
+        self._fc_gen = 0              # transport generation: fences grants
+        self._fc_active = False       # this incarnation negotiated credits
+        self._fc_starved_s = 0.0      # cumulative seconds parked at zero credit
         env_b, env_ms = env_flush_bytes(), env_flush_ms()
         self.flush_bytes = (env_b if env_b is not None
                             else flush_bytes if flush_bytes is not None
@@ -858,6 +976,16 @@ class RemoteChannelWriter:
             group.gauge("send_queue_bytes",
                         lambda: (0 if self._conn is None
                                  else self._conn.send_queue_bytes))
+            group.gauge("peak_send_queue_bytes",
+                        lambda: (0 if self._conn is None
+                                 else self._conn.peak_send_queue_bytes))
+            # Flow-control observability (the credit-starvation SLO rule
+            # and the doctor's bottleneck evidence read these): the live
+            # window and the cumulative seconds this edge spent parked
+            # at zero credit — a growing starved clock with a healthy
+            # peer names the downstream as the bottleneck.
+            group.gauge("credits_available", self._fc_credits_now)
+            group.gauge("credit_starved_s", lambda: self._fc_starved_s)
 
     # -- connection ------------------------------------------------------
     def _connect(self, timeout_s: typing.Optional[float] = None) -> None:
@@ -878,12 +1006,40 @@ class RemoteChannelWriter:
             )
             self._ring = ShmByteRing.create(path, self.shm_ring_bytes)
             opts.update({"shm": path, "capacity": self._ring.capacity})
+        # Flow control needs a grant lane: the shm ring's credit cell,
+        # or (TCP) the reactor delivering grant frames — a blocking
+        # standalone writer has neither and stays credit-free.
+        fc = self.flow_control and (self._ring is not None
+                                    or self._reactor is not None)
+        if fc:
+            opts["fc"] = True
         _send_obj(self._sock,
                   (self.task, self.subtask_index, self.channel_idx, opts))
+        with self._fc_cv:
+            # New transport generation: credits restart at zero and wait
+            # on the NEW route's initial grant; grant callbacks bound to
+            # a previous generation (a zombie connection's stale grants)
+            # are dropped at delivery.
+            self._fc_gen += 1
+            self._fc_credits = 0
+            self._fc_ring_spent = 0
+            self._fc_active = fc
+            gen = self._fc_gen
+            self._fc_cv.notify_all()
         if self._reactor is not None and self._ring is None:
             # Async sends: the reactor drains a bounded queue; errors
             # surface on the next write through the stored exception.
-            self._conn = Connection(self._reactor, self._sock)
+            if fc:
+                # Credit mode reads too: the receiver's grant frames
+                # arrive on this same socket and credit the window.
+                self._conn = Connection(
+                    self._reactor, self._sock,
+                    parser=ShuffleFrameParser(),
+                    on_message=lambda item, _g=gen: self._on_grant(item, _g),
+                    on_eof=lambda clean: self._fc_wake(),
+                    on_error=lambda exc: self._fc_wake())
+            else:
+                self._conn = Connection(self._reactor, self._sock)
             self._reactor.add_connection(self._conn)
 
     # -- write path ------------------------------------------------------
@@ -938,7 +1094,16 @@ class RemoteChannelWriter:
         itself towards the CURRENT buffer's deadline while records keep
         flowing; disarms when the writer idles or closes (the next first
         buffered record re-arms)."""
-        with self._lock:
+        if not self._lock.acquire(blocking=False):
+            # The writing thread holds the lock — possibly PARKED on a
+            # zero-credit edge.  Retry later: the process-wide
+            # FlushScheduler thread serves every edge and must never
+            # wait out one edge's backpressure.
+            FlushScheduler.shared().schedule(
+                time.monotonic() + max(self.flush_ms, 5.0) / 1e3,
+                self._timer_fire)
+            return
+        try:
             if self._closed or not self._buf:
                 self._timer_armed = False
                 return  # torn down, or flushed by size with no refill
@@ -955,10 +1120,23 @@ class RemoteChannelWriter:
                 # Off-thread failure: defer to the next write() so the
                 # OWNING subtask fails the job, not the shared timer.
                 self._error = exc
+        finally:
+            self._lock.release()
 
     def _flush_locked(self, reason: str) -> None:
         buf = self._buf
         if not buf:
+            return
+        if (reason == "timeout" and self.flush_ms > 0 and self._fc_active
+                and not self._fc_available()):
+            # Zero credit on a latency flush: keep buffering (bounded by
+            # the producer's own pace) and re-arm the deadline — the
+            # shared FlushScheduler thread must never park behind one
+            # stalled edge while every other edge's timers wait on it.
+            if not self._timer_armed:
+                self._timer_armed = True
+                FlushScheduler.shared().schedule(
+                    time.monotonic() + self.flush_ms / 1e3, self._timer_fire)
             return
         self._buf = []
         self._buf_bytes = 0
@@ -971,7 +1149,13 @@ class RemoteChannelWriter:
             obj = self._coalesce(buf)
         parts, payload_bytes = encode_obj_frame(obj)
         t1 = time.monotonic()
-        self._send_parts(parts, payload_bytes)
+        # Data ahead of a barrier/EOP may overdraw the window (bounded)
+        # so alignment can't wedge on a parked frame; plain size/timeout
+        # flushes park at zero — THE backpressure that keeps sender
+        # memory at one credit window under a stalled consumer.
+        self._send_parts(parts, payload_bytes,
+                         fc="align" if reason in ("barrier", "close")
+                         else "data")
         t2 = time.monotonic()
         if self._records is not None:
             self._records.inc(n)
@@ -1015,7 +1199,10 @@ class RemoteChannelWriter:
         t0 = time.monotonic()
         parts, payload_bytes = encode_obj_frame(element)
         t1 = time.monotonic()
-        self._send_parts(parts, payload_bytes)
+        # Lone control elements (barrier / watermark / EOP) BYPASS
+        # credit: a zero-credit edge must still align and terminate.
+        # The receiver's replenish accounting mirrors this exactly.
+        self._send_parts(parts, payload_bytes, fc="bypass")
         if self._records is not None and isinstance(element, el.StreamRecord):
             self._records.inc()
             self._bytes.inc(payload_bytes)
@@ -1030,12 +1217,16 @@ class RemoteChannelWriter:
             tracer.span(self._track, "wire", t1, t2,
                         args={"bytes": payload_bytes})
 
-    def _send_parts(self, parts, payload_bytes: int) -> None:
+    def _send_parts(self, parts, payload_bytes: int, fc: str = "data") -> None:
         try:
             if self._fault_hook is not None and self._fault_hook() == "drop":
                 return  # injected blackhole: the frame vanishes on the wire
             if self._sock is None:
                 self._connect()
+            # Spend AFTER the drop hook (a blackholed frame never reaches
+            # the receiver, so it must not consume a credit the receiver
+            # can never replenish) and BEFORE the bytes queue.
+            self._fc_acquire(fc)
             self._transmit(parts)
         except (OSError, ConnectionError):
             # Drop the dead transport so a LATER write reconnects instead
@@ -1074,6 +1265,107 @@ class RemoteChannelWriter:
         else:
             _sendall_parts(self._sock, parts)
 
+    # -- flow control ----------------------------------------------------
+    def _fc_available(self) -> bool:
+        """Non-destructive credit peek (writer lock held — only this
+        writer spends, so peek-then-acquire cannot race)."""
+        ring = self._ring
+        if ring is not None:
+            try:
+                return self._fc_ring_spent < ring.credits_granted()
+            except (ValueError, OSError):
+                return True  # ring torn down mid-peek: let send fail loudly
+        return self._fc_credits > 0
+
+    def _fc_credits_now(self) -> int:
+        """Live window for the ``credits_available`` gauge."""
+        ring = self._ring
+        if ring is not None and self._fc_active:
+            try:
+                return max(0, ring.credits_granted() - self._fc_ring_spent)
+            except (ValueError, OSError):
+                return 0
+        return self._fc_credits
+
+    def _on_grant(self, item, gen: int) -> bool:
+        """Receiver grant frame (reactor thread).  ``gen`` is the
+        transport generation the connection was built under: a grant
+        arriving for a TORN-DOWN generation — a zombie connection's
+        stale announcement racing a reconnect — is dropped, never
+        credited against the new transport's window."""
+        obj = item[0]
+        if (isinstance(obj, tuple) and len(obj) == 2
+                and obj[0] == CREDIT_GRANT):
+            with self._fc_cv:
+                if gen == self._fc_gen:
+                    self._fc_credits += int(obj[1])
+                    self._fc_cv.notify_all()
+        return True
+
+    def _fc_wake(self) -> None:
+        """Transport died (reactor thread): wake any parked sender so it
+        observes the closed connection and runs the reconnect path."""
+        with self._fc_cv:
+            self._fc_cv.notify_all()
+
+    def _fc_acquire(self, fc: str) -> None:
+        """Spend one credit for an outgoing frame, parking (interruptibly:
+        close / transport loss / reconnect all break the wait) while the
+        window is exhausted.  ``fc`` is the frame's class: "data" parks
+        at zero, "align" may overdraw by CREDIT_OVERFLOW_FRAMES (data
+        flushed ahead of a barrier must not wedge alignment), "bypass"
+        (control elements) spends nothing.  Called under the writer lock
+        — parking here IS the backpressure that throttles the producer
+        chain."""
+        if not self._fc_active or fc == "bypass":
+            return
+        floor = -CREDIT_OVERFLOW_FRAMES if fc == "align" else 0
+        if self._ring is not None:
+            self._fc_acquire_ring(floor)
+            return
+        t0 = None
+        with self._fc_cv:
+            gen = self._fc_gen
+            while (self._fc_credits <= floor and not self._closed
+                   and self._fc_gen == gen
+                   and self._conn is not None and not self._conn.closed):
+                if t0 is None:
+                    t0 = time.monotonic()
+                self._fc_cv.wait(0.05)
+            if t0 is not None:
+                self._fc_starved_s += time.monotonic() - t0
+            self._fc_credits -= 1
+        if self._tracer is not None and t0 is not None:
+            self._tracer.span(self._track, "wire.credit_wait",
+                              t0, time.monotonic())
+
+    def _fc_acquire_ring(self, floor: int) -> None:
+        """Ring-mode spend: compare our cumulative spent count with the
+        consumer's cumulative grant cell (both monotonic u64 — the SPSC
+        contract the ring cursors already rely on).  Backoff-sleep while
+        starved; close / ring teardown break the loop."""
+        t0 = None
+        while not self._closed:
+            ring = self._ring
+            if ring is None:
+                break
+            try:
+                granted = ring.credits_granted()
+            except (ValueError, OSError):
+                break  # torn down under us: let the write path fail loudly
+            if self._fc_ring_spent < granted - floor:
+                self._fc_ring_spent += 1
+                break
+            if t0 is None:
+                t0 = time.monotonic()
+            time.sleep(0.0005)
+        if t0 is not None:
+            dt = time.monotonic() - t0
+            self._fc_starved_s += dt
+            if self._tracer is not None:
+                self._tracer.span(self._track, "wire.credit_wait",
+                                  t0, t0 + dt)
+
     def _reconnect_and_resend(self, parts) -> bool:
         """Exponential-backoff reconnect after a transport failure,
         resending the in-flight frame; True on success.  The peer's
@@ -1108,6 +1400,14 @@ class RemoteChannelWriter:
         return False
 
     def _teardown_transport(self) -> None:
+        with self._fc_cv:
+            # Retire the generation: grants still in flight from the old
+            # transport (a zombie's stale announcements) become no-ops,
+            # and any parked sender wakes to observe the dead conn.
+            self._fc_gen += 1
+            self._fc_active = False
+            self._fc_credits = 0
+            self._fc_cv.notify_all()
         if self._conn is not None:
             self._conn.close()
             self._conn = None
@@ -1123,6 +1423,8 @@ class RemoteChannelWriter:
 
     def close(self) -> None:
         self._closed = True
+        with self._fc_cv:
+            self._fc_cv.notify_all()  # break any credit park immediately
         # Buffered records are dropped, matching the pre-coalescing
         # teardown semantics: a clean stream ends with EndOfPartition
         # (which force-flushed everything ahead of it), so anything

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import collections
 import os
+import select
 import selectors
 import socket
 import struct
@@ -52,7 +53,12 @@ import typing
 
 from flink_tensorflow_tpu.core import functions as fn
 from flink_tensorflow_tpu.core.reactor import FlushScheduler, LengthPrefixedParser
-from flink_tensorflow_tpu.core.shuffle import _sendall_parts, connect_with_retry
+from flink_tensorflow_tpu.core.shuffle import (
+    CREDIT_OVERFLOW_FRAMES,
+    _sendall_parts,
+    connect_with_retry,
+    credit_window,
+)
 from flink_tensorflow_tpu.tensors.serde import (
     batch_signature,
     decode_frame,
@@ -62,6 +68,20 @@ from flink_tensorflow_tpu.tensors.serde import (
 from flink_tensorflow_tpu.tensors.value import TensorValue
 
 _LEN = struct.Struct("<Q")
+
+#: Credit flow-control handshake on the job-to-job pipe: a sink that
+#: wants credits ships this 8-byte payload as an ordinary
+#: length-prefixed frame right after connecting (and after every
+#: reconnect).  A RemoteSource that understands it replies with credit
+#: grants — raw little-endian u64 *increments* on the sink-bound half
+#: of the same socket (the only bytes that ever flow that direction) —
+#: starting with an initial window of ``credit_window(queue_capacity)``
+#: frames.  Sinks that see no grant within the probe grace downgrade
+#: permanently to the classic credit-free wire, so raw TCP readers and
+#: pre-credit peers keep working unchanged.
+_FC_MAGIC = b"\xffFLOWCTL"
+_FC_PROBE_GRACE_S = 2.0
+_GRANT = struct.Struct("<Q")
 
 #: Cached origin pid for cross-process trace stamps (matches the
 #: tracer's own _PID — same process).
@@ -77,7 +97,8 @@ class RemoteSink(fn.SinkFunction):
                  flush_bytes: typing.Optional[int] = None,
                  flush_ms: typing.Optional[float] = None,
                  columnar: bool = True,
-                 reconnect_timeout_s: float = 5.0):
+                 reconnect_timeout_s: float = 5.0,
+                 flow_control: typing.Optional[bool] = None):
         from flink_tensorflow_tpu.tensors.serde import normalize_wire_dtype
 
         self.host = host
@@ -100,6 +121,10 @@ class RemoteSink(fn.SinkFunction):
         self.flush_bytes = flush_bytes
         self.flush_ms = flush_ms
         self.columnar = columnar
+        #: Credit-based flow control (module `_FC_MAGIC` docs): None
+        #: defers to JobConfig.flow_control at open(); False pins the
+        #: classic credit-free wire.
+        self.flow_control = flow_control
         self._wire: typing.Optional[str] = self.wire_dtype
         self._sock: typing.Optional[socket.socket] = None
         self._tracer = None
@@ -119,6 +144,16 @@ class RemoteSink(fn.SinkFunction):
         self._fault_hook = None
         self._reconnects = None
         self._edge_reconnects = None
+        # Credit state.  "off": classic wire.  "probe": hello sent,
+        # waiting for the peer's first grant.  "on": every data burst
+        # spends one credit; zero credit parks the producer.
+        self._fc_state = "off"
+        self._fc_credits = 0
+        self._fc_rxbuf = b""
+        self._fc_probe_waited = False
+        self._credit_starved_s = 0.0
+        self._resends = None
+        self._resent_total = 0
 
     def clone(self):
         return RemoteSink(self.host, self.port,
@@ -127,7 +162,8 @@ class RemoteSink(fn.SinkFunction):
                           flush_bytes=self.flush_bytes,
                           flush_ms=self.flush_ms,
                           columnar=self.columnar,
-                          reconnect_timeout_s=self.reconnect_timeout_s)
+                          reconnect_timeout_s=self.reconnect_timeout_s,
+                          flow_control=self.flow_control)
 
     def open(self, ctx) -> None:
         from flink_tensorflow_tpu.core.shuffle import (
@@ -164,6 +200,20 @@ class RemoteSink(fn.SinkFunction):
             self._frame_bytes = ctx.metrics.histogram("frame_bytes")
             self._flush_total = ctx.metrics.meter("wire_flush_total")
             self._reconnects = ctx.metrics.counter("reconnects")
+            #: Resent bursts are booked HERE, never on the wire_flush_*
+            #: reason counters — one logical flush ticks its reason
+            #: exactly once no matter how many times the burst hits the
+            #: wire, so attribution parity
+            #: (wire_flush_total == size+timeout+close) holds across
+            #: reconnects.
+            self._resends = ctx.metrics.counter("resent_bursts")
+            # Credit-plane observability (health rule `credit-starvation`
+            # + doctor bottleneck ranking key off these).
+            ctx.metrics.gauge("edge.credits_available",
+                              lambda: float(self._fc_credits)
+                              if self._fc_state == "on" else -1.0)
+            ctx.metrics.gauge("edge.credit_starved_s",
+                              lambda: self._credit_starved_s)
             registry = getattr(ctx.metrics, "_registry", None)
             if registry is not None:
                 self._edge_reconnects = registry.group("recovery").meter(
@@ -182,6 +232,105 @@ class RemoteSink(fn.SinkFunction):
         # starts.
         self._sock = connect_with_retry(
             self.host, self.port, self.connect_timeout_s)
+        fc_on = (self.flow_control if self.flow_control is not None
+                 else getattr(ctx, "flow_control", True))
+        if fc_on:
+            self._fc_hello()
+
+    def _fc_hello(self) -> None:
+        """Start the credit handshake on the current socket: ship the
+        FC hello frame and enter "probe" — the first grant (whenever it
+        arrives) locks credits on.  Probe is non-terminal: a silent
+        peer costs one probe-grace wait on the first burst, after which
+        bursts flow credit-free while the sink keeps listening — so raw
+        pre-credit readers never park this sink, yet a RemoteSource
+        whose generator starts late (a consumer already overloaded at
+        startup) still gets the credit loop the moment it grants."""
+        self._fc_state = "probe"
+        self._fc_probe_waited = False
+        self._fc_credits = 0
+        self._fc_rxbuf = b""
+        try:
+            self._sock.sendall(_LEN.pack(len(_FC_MAGIC)) + _FC_MAGIC)
+        except OSError:
+            pass  # the next burst's send notices and reconnects
+
+    def _harvest_grants(self, timeout: float) -> bool:
+        """Pull any credit grants off the sink-bound half of the socket
+        (raw u64 increments).  Returns False when the peer is gone (EOF
+        or socket error) — the caller stops parking and lets the send
+        path run its reconnect loop."""
+        sock = self._sock
+        if sock is None:
+            return False
+        try:
+            readable, _, _ = select.select([sock], [], [], timeout)
+        except (OSError, ValueError):
+            return False
+        if not readable:
+            return True
+        try:
+            chunk = sock.recv(4096)
+        except (BlockingIOError, InterruptedError):
+            return True
+        except OSError:
+            return False
+        if not chunk:
+            return False
+        buf = self._fc_rxbuf + chunk
+        while len(buf) >= _GRANT.size:
+            self._fc_credits += _GRANT.unpack_from(buf)[0]
+            buf = buf[_GRANT.size:]
+            if self._fc_state == "probe":
+                self._fc_state = "on"
+        self._fc_rxbuf = buf
+        return True
+
+    def _fc_available(self) -> bool:
+        """Non-destructive peek for the timeout-flush skip."""
+        if self._fc_state == "off":
+            return True
+        self._harvest_grants(0.0)
+        return self._fc_state != "on" or self._fc_credits > 0
+
+    def _fc_acquire(self, fc: str) -> None:
+        """Spend one credit for a burst about to hit the wire.
+
+        ``fc`` mirrors the shuffle writer's modes: "data" parks at
+        floor 0 until the RemoteSource grants; "align" (close-flush)
+        may overdraw to -CREDIT_OVERFLOW_FRAMES so teardown never
+        wedges on a stalled consumer; "bypass" (the EOS marker) spends
+        nothing.  Parked time accrues on ``edge.credit_starved_s``.
+        """
+        if fc == "bypass" or self._fc_state == "off":
+            return
+        if self._fc_state == "probe":
+            if not self._fc_probe_waited:
+                deadline = time.monotonic() + _FC_PROBE_GRACE_S
+                while (self._fc_state == "probe"
+                       and time.monotonic() < deadline):
+                    if not self._harvest_grants(0.05):
+                        break
+                self._fc_probe_waited = True
+            else:
+                self._harvest_grants(0.0)
+            if self._fc_state != "on":
+                return  # still probing: send credit-free, keep listening
+        floor = -CREDIT_OVERFLOW_FRAMES if fc == "align" else 0
+        self._harvest_grants(0.0)
+        if self._fc_credits > floor:
+            self._fc_credits -= 1
+            return
+        t0 = time.monotonic()
+        while self._fc_credits <= floor:
+            if not self._harvest_grants(0.05):
+                break  # peer gone; the send path reconnects (or raises)
+        waited = time.monotonic() - t0
+        self._credit_starved_s += waited
+        if self._tracer is not None and waited > 1e-3:
+            self._tracer.span(self._track, "wire.credit_wait",
+                              t0, time.monotonic(), args={"mode": fc})
+        self._fc_credits -= 1
 
     def invoke(self, value) -> None:
         if not isinstance(value, TensorValue):
@@ -230,7 +379,16 @@ class RemoteSink(fn.SinkFunction):
                 self._flush_locked("timeout")
 
     def _timer_fire(self) -> None:
-        with self._lock:
+        # Non-blocking acquire: the invoke thread may hold _lock for
+        # seconds while parked on credits, and this runs on the
+        # process-wide FlushScheduler thread — one starved edge must
+        # not stall every other edge's timers.
+        if not self._lock.acquire(blocking=False):
+            FlushScheduler.shared().schedule(
+                time.monotonic() + max(self._flush_ms, 5.0) / 1e3,
+                self._timer_fire)
+            return
+        try:
             if self._sock is None or not self._buf:
                 self._timer_armed = False
                 return
@@ -247,10 +405,23 @@ class RemoteSink(fn.SinkFunction):
                 # Off-thread failure: the next invoke() re-raises it on
                 # the sink's own subtask.
                 self._error = exc
+        finally:
+            self._lock.release()
 
     def _flush_locked(self, reason: str) -> None:
         buf = self._buf
         if not buf:
+            return
+        if (reason == "timeout" and self._flush_ms > 0
+                and self._fc_state == "on" and not self._fc_available()):
+            # Zero credit on a deadline flush: keep coalescing instead
+            # of parking the shared timer thread; the deadline re-arms
+            # and fires again once the consumer grants.
+            if not self._timer_armed:
+                self._timer_armed = True
+                FlushScheduler.shared().schedule(
+                    time.monotonic() + self._flush_ms / 1e3,
+                    self._timer_fire)
             return
         self._buf = []
         self._buf_bytes = 0
@@ -274,7 +445,7 @@ class RemoteSink(fn.SinkFunction):
                 parts.append(payload)
         burst_bytes = sum(len(p) for p in parts)
         t1 = time.monotonic()
-        self._send_burst(parts)
+        self._send_burst(parts, fc="align" if reason == "close" else "data")
         t2 = time.monotonic()
         if self._flush_counters is not None:
             self._flush_counters[reason].inc()
@@ -293,16 +464,21 @@ class RemoteSink(fn.SinkFunction):
             tracer.span(self._track, "wire", t1, t2,
                         args={"bytes": burst_bytes})
 
-    def _send_burst(self, parts) -> None:
+    def _send_burst(self, parts, fc: str = "data") -> None:
         """One burst onto the wire (scatter-gather sendmsg, no
-        concatenation copy), with the chaos hook and the self-healing
-        retry: a failed send reconnects with exponential backoff within
-        ``reconnect_timeout_s`` and resends the whole burst — the peer
-        RemoteSource keeps the fan-in slot open for the replacement
-        connection (see its reconnect grace)."""
+        concatenation copy), with the chaos hook, the credit gate, and
+        the self-healing retry: a failed send reconnects with
+        exponential backoff within ``reconnect_timeout_s`` and resends
+        the whole burst — the peer RemoteSource keeps the fan-in slot
+        open for the replacement connection (see its reconnect grace)."""
         try:
             if self._fault_hook is not None and self._fault_hook() == "drop":
-                return  # injected blackhole: the burst vanishes
+                # Injected blackhole: the burst vanishes.  Checked
+                # BEFORE the credit spend — the receiver never sees a
+                # dropped burst, so a spent credit could never be
+                # replenished (a slow leak of the window under chaos).
+                return
+            self._fc_acquire(fc)
             _sendall_parts(self._sock, parts)
             return
         except (OSError, ConnectionError):
@@ -329,6 +505,8 @@ class RemoteSink(fn.SinkFunction):
             try:
                 self._sock = connect_with_retry(
                     self.host, self.port, max(0.05, remaining))
+                self._reset_after_reconnect()
+                self._fc_acquire(fc)
                 _sendall_parts(self._sock, parts)
             except (OSError, ConnectionError, TimeoutError):
                 if self._sock is not None:
@@ -342,12 +520,34 @@ class RemoteSink(fn.SinkFunction):
                 self._reconnects.inc()
             if self._edge_reconnects is not None:
                 self._edge_reconnects.mark()
+            if self._resends is not None:
+                self._resends.inc()
+            self._resent_total += 1
             import logging
 
             logging.getLogger(__name__).warning(
                 "RemoteSink to %s:%d re-established after %d attempt(s); "
                 "in-flight burst resent", self.host, self.port, attempt)
             return
+
+    def _reset_after_reconnect(self) -> None:
+        """Fresh connection, fresh per-edge state.
+
+        Credits: grants from the dead socket died with it and the
+        replacement fan-in slot re-grants a full window, so the local
+        count restarts from the new hello (stale grants can never be
+        spent against the new connection).
+
+        Coalescing attribution: the buffer-age stamp is reset so the
+        resent burst's outage time is not billed to the NEXT buffer's
+        `wire.flush` span, and the resend itself is booked on the
+        `resent_bursts` counter only — the wire_flush_* reason counters
+        tick once per logical flush, keeping
+        wire_flush_total == size + timeout + close across reconnects.
+        """
+        if self._fc_state != "off":
+            self._fc_hello()
+        self._buf_t0 = time.monotonic()
 
     def close(self) -> None:
         if self._sock is not None:
@@ -421,6 +621,7 @@ class RemoteSource(fn.SourceFunction):
         self.queue_capacity = queue_capacity
         self._tracer = None
         self._track: typing.Optional[str] = None
+        self._credit_grants = None
 
     def clone(self):
         return self  # the listener is the identity; parallelism must be 1
@@ -428,6 +629,8 @@ class RemoteSource(fn.SourceFunction):
     def open(self, ctx) -> None:
         self._tracer = getattr(ctx, "tracer", None)
         self._track = f"{ctx.task_name}.{ctx.subtask_index}"
+        if ctx.metrics is not None:
+            self._credit_grants = ctx.metrics.counter("credit_grants")
         if ctx.parallelism != 1:
             raise RuntimeError(
                 "RemoteSource owns one listener — run it with "
@@ -455,6 +658,41 @@ class RemoteSource(fn.SourceFunction):
         lost_deadline = 0.0
         deadline = time.monotonic() + self.accept_timeout_s
         tracer = self._tracer
+        # Credit plane (module _FC_MAGIC docs): peers that sent the FC
+        # hello, the data frames consumed from each since the last
+        # grant, and grant bytes awaiting a writable socket.  Grants
+        # are queued only AFTER the frame's records were yielded — the
+        # pipeline demonstrably consumed them — so a stalled consumer
+        # stops the grant stream and parks the sender within one
+        # credit window.
+        window = credit_window(self.queue_capacity)
+        fc_conns: typing.Set[socket.socket] = set()
+        unacked: typing.Dict[socket.socket, int] = {}
+        grant_out: typing.Dict[socket.socket, bytearray] = {}
+        grants_counter = self._credit_grants
+
+        def queue_grant(conn: socket.socket, n: int) -> None:
+            grant_out.setdefault(conn, bytearray()).extend(_GRANT.pack(n))
+            if grants_counter is not None:
+                grants_counter.inc(n)
+
+        def flush_grants() -> None:
+            for c in list(grant_out):
+                buf = grant_out[c]
+                if c not in parsers or not buf:
+                    del grant_out[c]
+                    continue
+                try:
+                    sent = c.send(bytes(buf))
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    # Peer gone; its reconnect re-grants a full window.
+                    del grant_out[c]
+                    continue
+                del buf[:sent]
+                if not buf:
+                    del grant_out[c]
 
         def drop_unclean(conn: socket.socket, why: str):
             nonlocal lost, lost_deadline
@@ -465,6 +703,9 @@ class RemoteSource(fn.SourceFunction):
                 pass
             del parsers[conn]
             eos.discard(conn)
+            fc_conns.discard(conn)
+            unacked.pop(conn, None)
+            grant_out.pop(conn, None)
             if self.reconnect_grace_s <= 0:
                 raise ConnectionError(
                     f"remote peer dropped uncleanly ({why}) and "
@@ -483,6 +724,15 @@ class RemoteSource(fn.SourceFunction):
                 # pipeline lags would just buffer unboundedly.
                 while ready:
                     yield ready.popleft()
+                # Everything decoded so far has been consumed by the
+                # pipeline — NOW replenish the senders' credits.
+                if unacked:
+                    for c, n in unacked.items():
+                        if c in parsers:
+                            queue_grant(c, n)
+                    unacked.clear()
+                if grant_out:
+                    flush_grants()
                 now = time.monotonic()
                 if started < self.fan_in and now > deadline:
                     raise TimeoutError(
@@ -544,6 +794,9 @@ class RemoteSource(fn.SourceFunction):
                         conn.close()
                         del parsers[conn]
                         eos.discard(conn)
+                        fc_conns.discard(conn)
+                        unacked.pop(conn, None)
+                        grant_out.pop(conn, None)
                         continue
                     for payload, length in parser.feed(chunk):
                         if length == 0:
@@ -552,6 +805,18 @@ class RemoteSource(fn.SourceFunction):
                             eos.add(conn)
                             closed += 1
                             continue
+                        if (length == len(_FC_MAGIC)
+                                and payload == _FC_MAGIC):
+                            # Credit handshake: grant the initial
+                            # window (re-granted whole on reconnect —
+                            # the dead socket's credits died with it).
+                            fc_conns.add(conn)
+                            queue_grant(conn, window)
+                            continue
+                        if conn in fc_conns:
+                            # One credit per data frame, owed back once
+                            # its records are yielded downstream.
+                            unacked[conn] = unacked.get(conn, 0) + 1
                         if tracer is None:
                             ready.extend(decode_frame(payload))
                         else:

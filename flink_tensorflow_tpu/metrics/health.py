@@ -242,7 +242,8 @@ class _TargetState:
 
 def default_rules(*, channel_capacity: int = 1024) -> typing.Tuple[SloRule, ...]:
     """The shipped catalogue: backpressure (accumulated-seconds rate and
-    per-edge queue depth against the channel capacity), idleness,
+    per-edge queue depth against the channel capacity), credit
+    starvation on flow-controlled record-plane edges, idleness,
     checkpoint-duration creep, serving TTFT/admission pressure, and
     recovery churn.  Thresholds scale with ``channel_capacity`` where
     the signal is a queue depth."""
@@ -260,6 +261,20 @@ def default_rules(*, channel_capacity: int = 1024) -> typing.Tuple[SloRule, ...]
         # per-edge backpressure signal (one target per input edge).
         SloRule("edge-queue", "edge*_queue_depth",
                 warn=0.5 * cap, breach=0.9 * cap, action="scale_up"),
+        # Credit starvation: fraction of wall time a sender spent parked
+        # at zero credit — the flow-control view of "the consumer cannot
+        # keep up".  Two selectors because the senders live in different
+        # scope families: RemoteSink edges publish
+        # `edge.credit_starved_s` under their operator scope ("op.3",
+        # caught by the "*" rollup); shuffle-plane writers publish
+        # `credit_starved_s` under `shuffle.out.{task}.{n}.ch{k}`, which
+        # the "*" rollup skips (non-digit tail) and so needs its own
+        # scope glob.
+        SloRule("credit-starvation", "edge.credit_starved_s",
+                warn=0.5, breach=0.85, mode="rate", action="scale_up"),
+        SloRule("credit-starvation-shuffle", "credit_starved_s",
+                scope="shuffle.out.*", warn=0.5, breach=0.85,
+                mode="rate", action="scale_up"),
         # Sustained idleness = over-provisioned (scale-down hint); long
         # sustain so startup/drain phases don't trip it.
         SloRule("idle", "idle_s", warn=0.90, breach=0.99, mode="rate",

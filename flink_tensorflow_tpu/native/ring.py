@@ -211,6 +211,13 @@ class ShmByteRing:
     #: Consumer-parked doorbell flag (shares the read-mostly capacity
     #: cache line; written by the consumer, cleared by the producer).
     _PARK_OFF = 136
+    #: Cumulative credit grants (record-plane flow control): the
+    #: CONSUMER is the only writer — it adds the initial window at
+    #: attach and one credit per frame its gate drained; the producer
+    #: compares against its own spent-frames count before each write.
+    #: Cumulative u64 counters keep the cell SPSC-safe exactly like the
+    #: head/tail cursors (no read-modify-write races across processes).
+    _CREDIT_OFF = 144
 
     def __init__(self, path: str, mm: mmap.mmap, capacity: int, *,
                  created: bool):
@@ -239,6 +246,7 @@ class ShmByteRing:
         ring._store(cls._TAIL_OFF, 0)
         ring._store(cls._CAP_OFF, pow2)
         ring._store(cls._PARK_OFF, 0)
+        ring._store(cls._CREDIT_OFF, 0)
         return ring
 
     @classmethod
@@ -335,6 +343,17 @@ class ShmByteRing:
 
     def set_consumer_parked(self, parked: bool) -> None:
         self._store(self._PARK_OFF, 1 if parked else 0)
+
+    # -- flow control ----------------------------------------------------
+    def credits_granted(self) -> int:
+        """Cumulative credits the consumer has granted over the ring's
+        lifetime (producer side compares with its own spent total)."""
+        return self._load(self._CREDIT_OFF)
+
+    def add_credits(self, n: int) -> None:
+        """Grant ``n`` more frame credits (CONSUMER only — single
+        writer, like the head cursor)."""
+        self._store(self._CREDIT_OFF, self._load(self._CREDIT_OFF) + n)
 
     # -- consumer --------------------------------------------------------
     def readable(self) -> bool:

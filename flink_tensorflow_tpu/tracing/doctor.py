@@ -111,15 +111,40 @@ def bottleneck_ranking(snapshot: Snapshot
     """Operators ranked by backpressure evidence.  The headline signal
     is ``in_backpressure_s`` (time upstream writers spent blocked
     putting INTO this operator's gate — the operator that causes the
-    jam), tie-broken by buffered queue depth and own blocked time."""
+    jam), tie-broken by buffered queue depth, own blocked time, and
+    credit starvation on the operator's flow-controlled out-edges
+    (``credit_starved_s``; the worst such edge is named in
+    ``credit_edge`` so the report can point at the exact starved
+    link)."""
+    def _fresh() -> typing.Dict[str, float]:
+        return {"in_backpressure_s": 0.0, "queue_depth": 0.0,
+                "backpressure_s": 0.0, "idle_s": 0.0, "edge_depth": 0.0,
+                "credit_starved_s": 0.0}
+
     per_op: typing.Dict[str, typing.Dict[str, float]] = {}
+    credit_edges: typing.Dict[str, typing.Tuple[str, float]] = {}
+
+    def _credit(op: str, edge: str, starved: float) -> None:
+        per_op.setdefault(op, _fresh())["credit_starved_s"] += starved
+        best = credit_edges.get(op)
+        if best is None or starved > best[1]:
+            credit_edges[op] = (edge, starved)
+
     for scope, metrics in snapshot.items():
         task, index = _split_scope(scope)
         if index is None:
+            # Shuffle-plane credit telemetry lives under non-subtask
+            # scopes (`shuffle.out.{task}.{n}.ch{k}`) the generic fold
+            # skips — parse them explicitly so a credit-starved shuffle
+            # edge still ranks its SENDING operator.
+            if scope.startswith("shuffle.out."):
+                op_part = scope[len("shuffle.out."):].rsplit(".ch", 1)[0]
+                op, _idx = _split_scope(op_part)
+                v = _num(metrics.get("credit_starved_s"))
+                if v is not None and v > 0:
+                    _credit(op, scope, v)
             continue
-        agg = per_op.setdefault(task, {
-            "in_backpressure_s": 0.0, "queue_depth": 0.0,
-            "backpressure_s": 0.0, "idle_s": 0.0, "edge_depth": 0.0})
+        agg = per_op.setdefault(task, _fresh())
         for name, key in (("in_backpressure_s", "in_backpressure_s"),
                           ("queue_depth", "queue_depth"),
                           ("backpressure_s", "backpressure_s"),
@@ -132,11 +157,18 @@ def bottleneck_ranking(snapshot: Snapshot
                 v = _num(value)
                 if v is not None:
                     agg["edge_depth"] += v
-    ranked = [{"operator": op, **{k: round(v, 4) for k, v in agg.items()}}
+        # RemoteSink edges publish credit starvation under their own
+        # operator scope.
+        v = _num(metrics.get("edge.credit_starved_s"))
+        if v is not None and v > 0:
+            _credit(task, scope, v)
+    ranked = [{"operator": op, **{k: round(v, 4) for k, v in agg.items()},
+               "credit_edge": credit_edges.get(op, (None, 0.0))[0]}
               for op, agg in per_op.items()]
     ranked.sort(key=lambda r: (-r["in_backpressure_s"],
                                -max(r["queue_depth"], r["edge_depth"]),
-                               -r["backpressure_s"], r["operator"]))
+                               -r["backpressure_s"],
+                               -r["credit_starved_s"], r["operator"]))
     return ranked
 
 
@@ -205,7 +237,8 @@ def diagnose(
     rules = health_findings(snapshot, channel_capacity=channel_capacity)
     bottlenecks = [b for b in bottleneck_ranking(snapshot)
                    if b["in_backpressure_s"] > 0 or b["queue_depth"] > 0
-                   or b["edge_depth"] > 0 or b["backpressure_s"] > 0]
+                   or b["edge_depth"] > 0 or b["backpressure_s"] > 0
+                   or b.get("credit_starved_s", 0) > 0]
     stages = stage_dominance(events)
     actions = supervisor_actions(flight_docs, decision)
 
@@ -217,6 +250,12 @@ def diagnose(
         hit = [f for f in rules if f["target"].split("/", 1)[0] == op]
         rule_part = (f"{hit[0]['rule']} {hit[0]['state']}" if hit
                      else "no rule past threshold")
+        credit_part = ""
+        if b.get("credit_starved_s", 0) > 0 and b.get("credit_edge"):
+            credit_part = (
+                f"; credit-starved {b['credit_starved_s']:.2f}s on edge "
+                f"{b['credit_edge']} (the downstream consumer is not "
+                "granting — the jam is below this operator)")
         stage_part = ""
         if op in stages:
             s = stages[op]
@@ -227,7 +266,8 @@ def diagnose(
             f"#{rank} bottleneck {op}: {rule_part} — upstream blocked "
             f"{b['in_backpressure_s']:.2f}s, queue depth "
             f"{max(b['queue_depth'], b['edge_depth']):.0f}, own "
-            f"backpressure {b['backpressure_s']:.2f}s{stage_part}")
+            f"backpressure {b['backpressure_s']:.2f}s"
+            f"{credit_part}{stage_part}")
     for f in rules:
         op = f["target"].split("/", 1)[0]
         if op in named:

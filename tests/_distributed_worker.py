@@ -281,12 +281,28 @@ def main():
     p.add_argument("--flight", default=None,
                    help="flight-recorder dump path for this process")
     p.add_argument("--telemetry-interval", type=float, default=2.0)
+    p.add_argument("--cap", type=int, default=None,
+                   help="JobConfig.channel_capacity override — a small "
+                        "capacity shrinks the credit window so chaos "
+                        "soaks actually exercise zero-credit parking")
+    p.add_argument("--wire-flush-bytes", type=int, default=None,
+                   help="JobConfig.wire_flush_bytes override (frame "
+                        "quantum for the credit-window byte bound)")
+    p.add_argument("--metrics-out", default=None,
+                   help="dump this process's final metric-registry report "
+                        "as JSON (suffixed .proc<k>) — the chaos-soak "
+                        "flow-control arm reads the run-long "
+                        "peak_send_queue_bytes high-water marks from it")
     args = p.parse_args()
 
     ports = [int(x) for x in args.ports.split(",")]
     peers = tuple(f"127.0.0.1:{pt}" for pt in ports)
     env = StreamExecutionEnvironment(parallelism=1)
     env.configure(source_throttle_s=args.throttle)
+    if args.cap is not None:
+        env.configure(channel_capacity=args.cap)
+    if args.wire_flush_bytes is not None:
+        env.configure(wire_flush_bytes=args.wire_flush_bytes)
     if args.trace:
         env.configure(trace=True, trace_path=args.trace)
     if args.flight:
@@ -306,6 +322,7 @@ def main():
         else:
             _interval_join_stages(env, args)
         env.execute("dist-plane", timeout=180, **_restore_kwargs(args))
+        _dump_metrics(env, args)
         return
     if args.job == "keyed_train":
         stage = _keyed_train_stage(env, args)
@@ -331,6 +348,32 @@ def main():
             WindowSum(), name="keyed_window", parallelism=args.par)
     stage.add_sink(ExactlyOnceRecordFileSink(args.out), name="sink", parallelism=1)
     env.execute("dist-plane", timeout=180, **_restore_kwargs(args))
+    _dump_metrics(env, args)
+
+
+def _dump_metrics(env, args):
+    """Write the final metric report as JSON (gauges are sampled once at
+    dump time — for the run-long high-water marks like
+    ``peak_send_queue_bytes`` that IS the whole-run value)."""
+    if not args.metrics_out:
+        return
+    import json
+
+    def _jsonable(v):
+        if isinstance(v, dict):
+            return {k: _jsonable(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [_jsonable(x) for x in v]
+        if isinstance(v, (str, bool)) or v is None:
+            return v
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return str(v)
+
+    path = f"{args.metrics_out}.proc{args.index}"
+    with open(path, "w") as f:
+        json.dump(_jsonable(env.metric_registry.report()), f)
 
 
 def _restore_kwargs(args):

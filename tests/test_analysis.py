@@ -857,6 +857,76 @@ class TestExactlyOnceBoundaryLint:
             src.close()
 
 
+class TestFlowControlLint:
+    """flow-control: a checkpointed multi-process plan running with
+    ``JobConfig.flow_control=False`` behind an open-loop paced source is
+    the exact configuration whose sender queues (and checkpoint
+    alignment times) grow without bound under a consumer stall.  The
+    rule fires ONLY when every leg is present — disable any one and the
+    plan is defensible."""
+
+    @staticmethod
+    def _dist():
+        from flink_tensorflow_tpu.core.distributed import DistributedConfig
+
+        return DistributedConfig(
+            0, 2, ("127.0.0.1:9001", "127.0.0.1:9002"))
+
+    def _env(self, *, fc=False, dist=True, checkpoint=True, paced=True):
+        from flink_tensorflow_tpu.sources import PacedSplitSource
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.configure(flow_control=fc)
+        if dist:
+            env.set_distributed(self._dist())
+        if checkpoint:
+            env.enable_checkpointing("/tmp/fc-lint")
+        if paced:
+            stream = env.from_source(
+                PacedSplitSource([1, 2, 3], 100.0), name="paced")
+        else:
+            stream = env.from_collection([1, 2, 3])
+        stream.map(lambda x: x, name="m").sink_to_callable(lambda v: None)
+        return env
+
+    def test_open_loop_uncredited_checkpointed_cohort_warns(self):
+        env = self._env()
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "flow-control")
+        assert len(diags) == 1
+        assert diags[0].severity == Severity.WARN
+        assert diags[0].node == "paced"
+        assert "flow_control" in diags[0].message
+        assert "credit window" in diags[0].message
+
+    def test_flow_control_on_is_silent(self):
+        env = self._env(fc=True)
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "flow-control") == []
+
+    def test_single_process_is_silent(self):
+        # In-memory channels are bounded by construction.
+        env = self._env(dist=False)
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "flow-control") == []
+
+    def test_uncheckpointed_is_silent(self):
+        # No alignment to wedge — overload just slows the job down.
+        env = self._env(checkpoint=False)
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "flow-control") == []
+
+    def test_closed_loop_source_is_silent(self):
+        # A pull-paced collection source already closes the loop.
+        env = self._env(paced=False)
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "flow-control") == []
+
+    def test_bare_graph_without_config_skips(self):
+        env = self._env()
+        assert by_rule(analyze(env.graph), "flow-control") == []
+
+
 class TestSloUnmonitoredLint:
     """slo-unmonitored: JobConfig.health over a cohort whose telemetry
     service is off — the evaluator/actuator would watch process 0 only."""

@@ -199,6 +199,83 @@ class TestStallAndCheckpointAbort:
         assert latest is not None
         assert latest > min(coordinator.aborted_ids)
 
+    def test_zero_credit_edge_aborts_expired_checkpoint_then_succeeds(
+            self, tmp_path):
+        """Flow-control regression for the deadline-abort backstop: the
+        wedge here is NOT a stalled operator but a credit-PARKED remote
+        edge — the consumer stalls, stops granting, and the producer's
+        RemoteSink parks at zero credit with checkpoint barriers queued
+        behind it.  The coordinator's deadline sweeper must decline the
+        expired checkpoints (a zero-credit edge can park data, never
+        wedge the job), and once grants resume a LATER checkpoint
+        completes durably with nothing lost."""
+        from flink_tensorflow_tpu.checkpoint.store import latest_checkpoint_id
+        from flink_tensorflow_tpu.io.remote import RemoteSink, RemoteSource
+
+        out = str(tmp_path / "pipe-abort")
+        # Tiny receive queue -> credit window of 2: the park is reached
+        # within a handful of records once grants stop.
+        source = RemoteSource(bind="127.0.0.1", queue_capacity=64)
+        errors = []
+
+        def consume():
+            try:
+                cenv = StreamExecutionEnvironment(parallelism=1)
+                # Stall the CONSUMER pipeline (the sink is chained into
+                # the source, so the source scope is the record point):
+                # the stalled chain stops pulling the RemoteSource
+                # generator, grants stop, and the producer-side sink
+                # parks at zero credit.
+                cenv.configure(faults="stall:rsrc.0@6~0.8")
+                cenv.from_source(source, name="rsrc").add_sink(
+                    ExactlyOnceRecordFileSink(out), name="csink")
+                cenv.execute("consumer-abort", timeout=60)
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.enable_checkpointing(str(tmp_path / "chk-abort"),
+                                 every_n_records=5)
+        # chaining=False keeps src/tv/rsink separate subtasks: a barrier
+        # cut while the sink is parked sits in a real queue BEHIND the
+        # park (in a fused chain the source thread itself would park, so
+        # no barrier could ever be pending during the wedge).
+        env.configure(chaining=False, checkpoint=dataclasses.replace(
+            env.config.checkpoint, timeout_s=0.25))
+        # Pace the source PAST the 0.8s park so checkpoints keep being
+        # cut after grants resume — the ones cut while the sink was
+        # parked expire and abort, the later ones must complete.
+        env.source_throttle_s = 0.012
+        (
+            env.from_collection(list(range(120)), name="src")
+            .map(lambda v: TensorValue({"v": np.int64(v)}, {"i": int(v)}),
+                 name="tv")
+            .add_sink(RemoteSink("127.0.0.1", source.port, flush_bytes=0),
+                      name="rsink")
+        )
+        handle = env.execute_async("producer-abort")
+        handle.wait(120)
+        t.join(60)
+        assert not errors, errors
+        rep = env.metric_registry.report()
+        # The edge really did hit zero credit (this is what distinguishes
+        # the regression from the plain operator-stall abort above) ...
+        assert rep["rsink.0.edge.credit_starved_s"] > 0.2
+        # ... the sweeper declined at least one expired checkpoint ...
+        coordinator = handle.executor.coordinator
+        assert rep["recovery.checkpoints_aborted"] >= 1
+        assert coordinator.aborted_ids
+        # ... a NEWER checkpoint completed once grants resumed ...
+        latest = latest_checkpoint_id(str(tmp_path / "chk-abort"))
+        assert latest is not None
+        assert latest > min(coordinator.aborted_ids)
+        # ... and the stream itself lost nothing through the park.
+        got = sorted((int(r.meta["i"]), int(r["v"]))
+                     for r in read_committed(out))
+        assert got == [(i, i) for i in range(120)]
+
 
 class TestStoreFailure:
     def test_store_write_failure_declines_checkpoint(self, tmp_path):
@@ -269,6 +346,27 @@ class TestSeverRecovery:
         assert rep["rsink.0.reconnects"] == 1
         assert rep["faults.sever"]["count"] == 1
         assert rep["recovery.edge_reconnects"]["count"] == 1
+
+    def test_reconnect_resets_coalescing_counters_parity(self, tmp_path):
+        """Regression (flow-control PR): a reconnect must RESET the
+        per-edge coalescing bookkeeping, not double-book the resent
+        burst — the flush-reason attribution identity
+        ``wire_flush_total == size + timeout + close`` has to hold
+        across the sever, with the replay visible ONLY on
+        ``resent_bursts``.  The credit handshake also re-runs on the
+        replacement socket (credits_available >= 0 means the loop came
+        back up, not the -1 'credit-free' sentinel)."""
+        _, baseline = self._pipe(tmp_path, "fc-baseline")
+        env, out = self._pipe(tmp_path, "fc-sever", faults="sever:rsink.0@3")
+        assert committed_bytes(out) == committed_bytes(baseline)
+        rep = env.metric_registry.report()
+        assert rep["rsink.0.reconnects"] == 1
+        assert rep["rsink.0.resent_bursts"] >= 1
+        by_reason = (rep["rsink.0.wire_flush_size"]
+                     + rep["rsink.0.wire_flush_timeout"]
+                     + rep["rsink.0.wire_flush_close"])
+        assert rep["rsink.0.wire_flush_total"]["count"] == by_reason
+        assert rep["rsink.0.edge.credits_available"] >= 0.0
 
 
 class TestEpochFence:
@@ -489,3 +587,91 @@ class TestCohortChaosSoak:
             for r in read_committed(out)
         )
         assert got == expected_emissions(n)
+
+    def test_stall_delay_soak_flow_control_bounds_sender_queue(self, tmp_path):
+        """Flow-control chaos-soak arm: a 2-process cohort runs the keyed
+        job under scheduled ``stall`` + ``delay`` faults with credits ON
+        and a deliberately tiny channel capacity (credit window 2).  The
+        stalled consumer stops granting, so the producer-side remote
+        writers must PARK rather than buffer: every cross-process edge's
+        run-long ``peak_send_queue_bytes`` high-water mark stays under
+        credit window x frame quantum for the WHOLE run, and the
+        committed output is still byte-for-byte the fault-free
+        expectation (0 records lost through the parks)."""
+        import json
+
+        from flink_tensorflow_tpu.core.shuffle import (
+            CREDIT_OVERFLOW_FRAMES,
+            credit_window,
+        )
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from _distributed_worker import expected_emissions  # noqa: E402
+
+        worker = os.path.join(os.path.dirname(__file__),
+                              "_distributed_worker.py")
+        n, every, cap, flush_bytes = 240, 40, 64, 512
+        out = str(tmp_path / "out")
+        chk = str(tmp_path / "chk")
+        metrics = str(tmp_path / "metrics.json")
+        # Subtask 1 of the keyed stage (round-robin -> process 1) stalls
+        # mid-stream; subtask 0 (process 0) gets a burst of per-record
+        # delays.  Both workers receive the full plan — each injector
+        # fires only where its subtask actually lives.
+        faults = "stall:keyed_sum.1@40~0.5;delay:keyed_sum.0@30~0.004x25"
+        ports = _free_ports(2)
+        procs = []
+        for i in range(2):
+            cmd = [sys.executable, worker, "--index", str(i),
+                   "--ports", ",".join(map(str, ports)), "--out", out,
+                   "--n", str(n), "--every", str(every),
+                   "--throttle", "0.005", "--chk", chk,
+                   "--cap", str(cap),
+                   "--wire-flush-bytes", str(flush_bytes),
+                   "--metrics-out", metrics]
+            env_vars = dict(os.environ)
+            env_vars["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__)),
+                 env_vars.get("PYTHONPATH", "")])
+            env_vars["FLINK_TPU_FAULTS"] = faults
+            procs.append(subprocess.Popen(cmd, env=env_vars,
+                                          stdout=subprocess.PIPE,
+                                          stderr=subprocess.STDOUT))
+        for i, p in enumerate(procs):
+            try:
+                pout, _ = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                pout, _ = p.communicate()
+                raise AssertionError(
+                    f"worker {i} hung:\n{pout.decode(errors='replace')}")
+            assert p.returncode == 0, (
+                f"worker {i} failed:\n{pout.decode(errors='replace')}")
+        # Exactly-once through the parks: byte-identical to the
+        # fault-free expectation, 0 lost, 0 duplicated.
+        got = sorted(
+            (int(r.meta["key"]), int(r.meta["i"]), int(r["v"]))
+            for r in read_committed(out)
+        )
+        assert got == expected_emissions(n)
+        # The bounded-memory claim, asserted from each process's final
+        # metric dump: peak_send_queue_bytes is a run-long high-water
+        # mark, so reading it once at exit IS the whole-run assertion.
+        # Bound = (window + barrier-overdraw allowance) frames of
+        # (flush quantum + one straggler record / control frame).
+        bound = ((credit_window(cap) + CREDIT_OVERFLOW_FRAMES)
+                 * (flush_bytes + 4096))
+        saw_remote_edge = False
+        saw_grants = False
+        for i in range(2):
+            with open(f"{metrics}.proc{i}") as f:
+                rep = json.load(f)
+            for key, val in rep.items():
+                if (key.startswith("shuffle.out.")
+                        and key.endswith(".peak_send_queue_bytes")):
+                    saw_remote_edge = True
+                    assert val <= bound, (key, val, bound)
+                if key.endswith(".credit_grants") and val > 0:
+                    saw_grants = True
+        assert saw_remote_edge, "no cross-process edge metrics dumped"
+        assert saw_grants, "credit loop never engaged during the soak"

@@ -168,6 +168,66 @@ class TestHysteresis:
 
 
 # ---------------------------------------------------------------------------
+# credit-starvation SLO (flow-control plane)
+# ---------------------------------------------------------------------------
+
+
+class TestCreditStarvationRule:
+    """The flow-control SLO: the starved clocks are CUMULATIVE
+    seconds-parked-at-zero-credit gauges, so the rules run mode="rate" —
+    the fraction of wall time the edge spent parked.  Two scope
+    families carry them: RemoteSink edges publish
+    ``edge.credit_starved_s`` under their operator scope, shuffle-plane
+    writers publish ``credit_starved_s`` under
+    ``shuffle.out.{task}.{n}.ch{k}``."""
+
+    def _rule(self, rid):
+        return next(r for r in default_rules() if r.id == rid)
+
+    def test_catalogue_carries_both_scope_families(self):
+        for rid in ("credit-starvation", "credit-starvation-shuffle"):
+            rule = self._rule(rid)
+            rule.validate()
+            assert rule.mode == "rate"
+            assert rule.action == "scale_up"
+
+    def test_operator_scope_starved_clock_breaches_on_rate(self):
+        # A RemoteSink edge parked 0.9s of every second: rate 0.9 >
+        # breach 0.85, sustained 3 ticks (first sight yields no rate).
+        ev = HealthEvaluator([self._rule("credit-starvation")])
+        fired = []
+        for i, raw in enumerate([0.0, 0.9, 1.8, 2.7]):
+            fired.extend(ev.evaluate_once(
+                {"rsink.0": {"edge.credit_starved_s": raw}}, now=100.0 + i))
+        assert [(t.old, t.new) for t in fired] == [(OK, BREACH)]
+        assert fired[0].action == "scale_up"
+        assert fired[0].value == pytest.approx(0.9)
+
+    def test_shuffle_scope_starved_clock_breaches_on_rate(self):
+        ev = HealthEvaluator([self._rule("credit-starvation-shuffle")])
+        fired = []
+        for i, raw in enumerate([0.0, 0.9, 1.8, 2.7]):
+            fired.extend(ev.evaluate_once(
+                {"shuffle.out.op.0.ch0": {"credit_starved_s": raw}},
+                now=100.0 + i))
+        assert [(t.old, t.new) for t in fired] == [(OK, BREACH)]
+
+    def test_briefly_parked_edge_stays_ok(self):
+        # 10% of wall time at zero credit is normal coalescing weather —
+        # well under warn (0.5), neither rule may fire.
+        rules = [self._rule("credit-starvation"),
+                 self._rule("credit-starvation-shuffle")]
+        ev = HealthEvaluator(rules)
+        fired = []
+        for i, raw in enumerate([0.0, 0.1, 0.2, 0.3, 0.4]):
+            fired.extend(ev.evaluate_once(
+                {"rsink.0": {"edge.credit_starved_s": raw},
+                 "shuffle.out.op.0.ch0": {"credit_starved_s": raw}},
+                now=100.0 + i))
+        assert fired == []
+
+
+# ---------------------------------------------------------------------------
 # evaluator publication: gauges, flight, rollups
 # ---------------------------------------------------------------------------
 
@@ -497,6 +557,44 @@ class TestDoctor:
 
         report = diagnose(self.SNAP, channel_capacity=8)
         assert any("no autoscale decision" in f for f in report["findings"])
+
+    CREDIT_SNAP = {
+        # The sender is hot (blocked upstream writers) AND its shuffle
+        # out-edge spent 2.5s parked at zero credit; the RemoteSink edge
+        # on "pipe" carries the operator-scope flavour of the clock.
+        "up.0": {"in_backpressure_s": 4.0, "backpressure_s": 3.0,
+                 "idle_s": 0.0},
+        "shuffle.out.up.0.ch2": {"credit_starved_s": 2.5,
+                                 "credits_available": 0.0},
+        "shuffle.out.up.0.ch1": {"credit_starved_s": 0.4},
+        "pipe.0": {"edge.credit_starved_s": 1.2, "idle_s": 0.1},
+        "down.0": {"idle_s": 5.0},
+    }
+
+    def test_bottleneck_ranking_carries_credit_evidence(self):
+        from flink_tensorflow_tpu.tracing.doctor import bottleneck_ranking
+
+        ranked = {r["operator"]: r
+                  for r in bottleneck_ranking(self.CREDIT_SNAP)}
+        # Shuffle-plane scopes fold onto their SENDING operator; the
+        # worst-starved edge is named so the report can point at the
+        # exact link.
+        assert ranked["up"]["credit_starved_s"] == pytest.approx(2.9)
+        assert ranked["up"]["credit_edge"] == "shuffle.out.up.0.ch2"
+        # RemoteSink edges book under their own operator scope.
+        assert ranked["pipe"]["credit_starved_s"] == pytest.approx(1.2)
+        assert ranked["pipe"]["credit_edge"] == "pipe.0"
+        assert ranked["down"]["credit_starved_s"] == 0.0
+        assert ranked["down"]["credit_edge"] is None
+
+    def test_diagnose_names_credit_starved_edge(self):
+        from flink_tensorflow_tpu.tracing.doctor import diagnose
+
+        report = diagnose(self.CREDIT_SNAP, channel_capacity=8)
+        head = report["findings"][0]
+        assert "#1 bottleneck up" in head
+        assert "credit-starved 2.90s on edge shuffle.out.up.0.ch2" in head
+        assert "the jam is below this operator" in head
 
     def test_cli_round_trip(self, tmp_path):
         from flink_tensorflow_tpu.tracing.doctor import main
