@@ -186,6 +186,21 @@ def _flight_record_overhead_ns(samples: int = 20000) -> float:
         flight.record("bench", "overhead_probe")
     return (time.perf_counter() - t0) / samples * 1e9
 
+
+def _hb_record_overhead_ns(samples: int = 20000) -> float:
+    """Micro-measure of one cross-process happens-before event (seq
+    counter bump + bounded deque append) on the sanitizer's record-plane
+    hot path — the per-frame/per-credit cost a sanitized distributed run
+    pays, priced next to span/flight so the three observability rings
+    stay comparable."""
+    from flink_tensorflow_tpu.core.sanitizer_rt import ConcurrencySanitizer
+
+    san = ConcurrencySanitizer(name="bench")
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        san.hb("frame.send", "bench.0[ch0]", "0:1", fc="data", nbytes=256)
+    return (time.perf_counter() - t0) / samples * 1e9
+
 # Prose annotations for the machine-readable ceiling-drift code (the
 # code is the source of truth; prose is presentation only).
 CEILING_DRIFT_PROSE = {
@@ -2460,6 +2475,7 @@ def _shuffle_cohort_telemetry(args) -> dict:
         "stitch_wall_s": round(merge_wall_s, 4),
         "span_record_ns": round(_trace_span_overhead_ns(), 1),
         "flight_record_ns": round(_flight_record_overhead_ns(), 1),
+        "hb_record_ns": round(_hb_record_overhead_ns(), 1),
     }
 
 
@@ -3629,6 +3645,10 @@ def _scoreboard(outputs: list) -> dict:
             # The always-on flight recorder's per-event cost: must stay
             # within the span-record bound (ISSUE 9 acceptance).
             "flight_record_ns": round(_flight_record_overhead_ns(), 1),
+            # Distributed sanitizer happens-before capture: what each
+            # record-plane seam (frame/credit/barrier/handshake) costs
+            # per event when a cohort runs with the sanitizer on.
+            "hb_record_ns": round(_hb_record_overhead_ns(), 1),
             "trace_files": len(_TRACE_FILES),
         }
     wire, wire_pre = flag.get("wire") or {}, flag.get("wire_pre") or {}

@@ -58,7 +58,7 @@ class CohortTelemetryService:
     def __init__(self, *, process_index: int, num_processes: int,
                  pid: int,
                  send: typing.Callable[[int, typing.Any], None],
-                 registry, tracer=None, flight=None,
+                 registry, tracer=None, flight=None, sanitizer=None,
                  interval_s: float = 2.0, startup_pings: int = 5):
         self.process_index = process_index
         self.num_processes = num_processes
@@ -67,6 +67,10 @@ class CohortTelemetryService:
         self.registry = registry
         self.tracer = tracer
         self.flight = flight
+        #: ConcurrencySanitizer (or None): receives the same cohort
+        #: identity block as the tracer, so happens-before logs are
+        #: orderable onto the process-0 timebase even with tracing off.
+        self.sanitizer = sanitizer
         self.interval_s = interval_s
         self.startup_pings = startup_pings
         #: Process-0 side: the cohort aggregation point (exists only
@@ -245,6 +249,13 @@ class CohortTelemetryService:
             applied += 1
         if tracer is not None:
             tracer.cohort_meta = {
+                "process_index": self.process_index,
+                "pid": self.pid,
+                "offset_to_proc0_s": off_self,
+                "error_bound_s": err_self,
+            }
+        if self.sanitizer is not None:
+            self.sanitizer.cohort_meta = {
                 "process_index": self.process_index,
                 "pid": self.pid,
                 "offset_to_proc0_s": off_self,

@@ -116,6 +116,11 @@ class JobConfig:
     #: The FLINK_TPU_SANITIZE=1 env var force-enables it without config
     #: changes; FLINK_TPU_SANITIZE_STALL_S adds the stall watchdog.
     sanitize: bool = False
+    #: Where the sanitizer's cross-process happens-before event log is
+    #: dumped (the ``flink-tpu-sanitize --cohort`` input); a cohort
+    #: process suffixes ``.proc<k>`` before the extension.  None keeps
+    #: the ring in memory only.  FLINK_TPU_SANITIZE_LOG overrides.
+    sanitize_log_path: typing.Optional[str] = None
     #: End-to-end span tracing (flink_tensorflow_tpu.tracing): thread a
     #: per-record/per-batch trace context from source admission through
     #: chains, channels, h2d/compute/d2h, checkpoint alignment, split

@@ -249,6 +249,26 @@ class DistributedExecutor(LocalExecutor):
             root, ext = os.path.splitext(self.trace_path)
             self.trace_path = (
                 f"{root}.proc{self.dist.process_index}{ext or '.json'}")
+        # Per-process sanitizer happens-before logs, same shape: the
+        # cohort stitcher (`flink-tpu-sanitize --cohort`) consumes the
+        # .proc<k> file set.
+        if self.sanitize_log_path and self.dist.num_processes > 1:
+            root, ext = os.path.splitext(self.sanitize_log_path)
+            self.sanitize_log_path = (
+                f"{root}.proc{self.dist.process_index}{ext or '.json'}")
+        if self.sanitizer is not None:
+            # Same pre-sync default as the tracer below; the telemetry
+            # service overwrites both with measured offsets.  The server
+            # was built before the sanitizer existed — attach it before
+            # start() opens the listener, so every route records.
+            self.sanitizer.cohort_meta = {
+                "process_index": self.dist.process_index,
+                "pid": os.getpid(),
+                "offset_to_proc0_s": 0.0,
+                "error_bound_s": float(
+                    "inf") if self.dist.process_index else 0.0,
+            }
+            self._server.sanitizer = self.sanitizer
         if self.tracer is not None:
             # Exported even before (or without) clock sync: the merge
             # then treats this process as offset-0, which is exact for
@@ -272,6 +292,7 @@ class DistributedExecutor(LocalExecutor):
             registry=self.metrics,
             tracer=self.tracer,
             flight=self.flight,
+            sanitizer=self.sanitizer,
             interval_s=self.dist.telemetry_interval_s,
         )
         #: The cohort-wide merged metric feed (process 0 only; None on
@@ -327,6 +348,9 @@ class DistributedExecutor(LocalExecutor):
             # writers (_get_control_writer) stay credit-free — 2PC
             # announcements and aborts must never park behind data.
             flow_control=self.flow_control,
+            # Distributed sanitizer: the writer logs the send half of
+            # every happens-before edge this connection crosses.
+            sanitizer=self.sanitizer,
         )
         self._remote_writers.append(writer)
         return writer

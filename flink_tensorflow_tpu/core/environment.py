@@ -382,6 +382,7 @@ class StreamExecutionEnvironment:
             max_parallelism=cfg.max_parallelism,
             chaining=cfg.chaining,
             sanitize=cfg.sanitize,
+            sanitize_log_path=cfg.sanitize_log_path,
             device_resident=cfg.device_resident,
             wire_dtype=cfg.wire_dtype,
             wire_flush_bytes=cfg.wire_flush_bytes,

@@ -340,6 +340,9 @@ class _Subtask:
         if flight is not None:
             flight.record(self.scope, "barrier.inject",
                           {"checkpoint": checkpoint_id})
+        san = self.executor.sanitizer
+        if san is not None:
+            san.hb("barrier.inject", self.scope, cid=checkpoint_id)
         self._snapshot_and_ack(checkpoint_id)
         self.output.broadcast_element(el.CheckpointBarrier(checkpoint_id))
 
@@ -422,6 +425,9 @@ class _Subtask:
         if flight is not None:
             flight.record(self.scope, "barrier.inject",
                           {"checkpoint": checkpoint_id})
+        san = self.executor.sanitizer
+        if san is not None:
+            san.hb("barrier.inject", self.scope, cid=checkpoint_id)
         op = typing.cast("typing.Any", self.operator)
         op.on_barrier(checkpoint_id)
         self._snapshot_and_ack(checkpoint_id)
@@ -703,6 +709,7 @@ class LocalExecutor:
         max_parallelism: int = 128,
         chaining: bool = True,
         sanitize: bool = False,
+        sanitize_log_path: typing.Optional[str] = None,
         trace: bool = False,
         trace_path: typing.Optional[str] = None,
         trace_sample_rate: float = 1.0,
@@ -768,6 +775,12 @@ class LocalExecutor:
             sanitizer_rt.ConcurrencySanitizer(name="executor")
             if (sanitize or sanitizer_rt.env_enabled()) else None
         )
+        #: Happens-before event-log destination (core/sanitizer_stitch
+        #: input): JobConfig.sanitize_log_path or FLINK_TPU_SANITIZE_LOG.
+        #: Kept even when the sanitizer is off so the distributed layer
+        #: can test it unconditionally; no sanitizer → no dump.
+        self.sanitize_log_path = (
+            sanitize_log_path or sanitizer_rt.env_hb_log_path())
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         #: Span tracer (flink_tensorflow_tpu.tracing): JobConfig.trace
@@ -867,6 +880,15 @@ class LocalExecutor:
             grp = self.metrics.group("sanitizer")
             grp.gauge("violations", lambda: len(self.sanitizer.violations))
             grp.gauge("tracked_ops", lambda: self.sanitizer.progress_ops)
+            # Cross-process happens-before log (PR 15): ring occupancy
+            # and drop counts ride the cohort telemetry pushes so the
+            # stitcher's truncation caveats are visible live.
+            cohort = self.metrics.group("sanitizer.cohort")
+            cohort.gauge("hb_events", lambda: self.sanitizer.hb_events)
+            cohort.gauge("hb_recorded", lambda: self.sanitizer.hb_recorded)
+            cohort.gauge("hb_dropped", lambda: self.sanitizer.hb_dropped)
+            cohort.gauge("violations",
+                         lambda: len(self.sanitizer.violations))
 
     # --- plan construction ----------------------------------------------
     def _build(self) -> None:
@@ -1100,6 +1122,10 @@ class LocalExecutor:
             # ctx.tracer at open() and record their stage spans
             # (h2d/compute/d2h, serde/wire) on this unit's track.
             ctx.tracer = self.tracer
+            # Sanitizer hand-off: remote sinks/sources log cross-process
+            # happens-before events (frame send/recv, credit grant/spend)
+            # through this at open().
+            ctx.sanitizer = self.sanitizer
             # Device-residency hand-off: model functions resolve their
             # emission mode / h2d wire dtype from these at open().
             ctx.device_resident = self.device_resident
@@ -1368,7 +1394,13 @@ class LocalExecutor:
                     self.flight.record("job", "sanitizer.violation", {
                         "violations": len(self.sanitizer.violations)})
                     self.flight_dump("sanitizer")
+                self.sanitizer_log_dump("violation")
                 raise
+            # Clean drain: the happens-before log is the stitcher's
+            # input — dump it on SUCCESS too, so `flink-tpu-sanitize
+            # --cohort` can prove the run conformant (zero violations is
+            # an assertion, not an absence of evidence).
+            self.sanitizer_log_dump("shutdown")
 
     def run(self, timeout: typing.Optional[float] = None) -> None:
         self.start()
@@ -1377,11 +1409,29 @@ class LocalExecutor:
     # --- failure / teardown ----------------------------------------------
     def flight_dump(self, reason: str) -> typing.Optional[str]:
         """Dump the flight ring to the configured path (no-op without a
-        recorder or a path); returns the written path."""
+        recorder or a path); returns the written path.  Each artifact
+        references the other: the flight dump carries the sanitizer
+        event-log path (and vice versa), so whichever one a responder
+        finds first points at the rest of the evidence."""
         if self.flight is None or not self.flight_path:
             return None
-        return self.flight.dump(self.flight_path, reason,
-                                tracer=self.tracer)
+        extra = ({"sanitizer_log": self.sanitize_log_path}
+                 if self.sanitize_log_path else None)
+        path = self.flight.dump(self.flight_path, reason,
+                                tracer=self.tracer, extra=extra)
+        self.sanitizer_log_dump(reason)
+        return path
+
+    def sanitizer_log_dump(self, reason: str) -> typing.Optional[str]:
+        """Dump the sanitizer's happens-before event log to the
+        configured path (no-op without a sanitizer or a path); returns
+        the written path.  Idempotent per reason, like flight_dump."""
+        if self.sanitizer is None or not self.sanitize_log_path:
+            return None
+        extra = ({"flight_dump": self.flight_path}
+                 if self.flight is not None and self.flight_path else None)
+        return self.sanitizer.dump_hb_log(
+            self.sanitize_log_path, reason, extra=extra)
 
     def fail(self, subtask: _Subtask, exc: BaseException) -> None:
         with self._error_lock:

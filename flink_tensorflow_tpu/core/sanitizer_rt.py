@@ -36,6 +36,22 @@ healthy pipelines park untimed legitimately (an idle worker waits for
 its source through a 30 s XLA compile), so the stall budget is a test /
 triage knob, not a steady-state invariant.
 
+**Cross-process happens-before log.**  Every record-plane seam — frame
+send/recv with per-(edge, connection) sequence numbers, barrier
+inject/align, credit grants/spends with their flow-control generation,
+restart-epoch handshakes — appends one compact event to a bounded
+per-process ring (:meth:`ConcurrencySanitizer.hb`), dumped alongside
+the flight recorder (``FLINK_TPU_SANITIZE_LOG`` /
+``JobConfig(sanitize_log_path=...)``).  The per-process log is half the
+story: ``core/sanitizer_stitch.py`` merges a cohort's logs on the
+clock-offset table (tracing/clocksync.py) and runs the *distributed*
+conformance checks no single process can see — delivery from an
+alignment-blocked channel's peer, credit spends past the granted
+window, stale-epoch frames reaching an operator, barrier reorder on
+the wire, and cross-process waits-for cycles (parked sender ↔ gate-full
+receiver) reported as deadlocks instead of hangs.  Surfaced as
+``flink-tpu-sanitize --cohort``.
+
 **Protocol state machines.**  Independent re-derivations of the
 runtime's checkpoint invariants, fed by hooks at the protocol points —
 they catch a buggy *implementation* because they do not trust it:
@@ -58,7 +74,9 @@ When off, nothing here is constructed: the runtime takes plain
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import json
 import logging
 import os
 import sys
@@ -70,6 +88,16 @@ import typing
 logger = logging.getLogger(__name__)
 
 _TRUTHY = ("1", "true", "on", "yes")
+
+#: Document marker for per-process happens-before logs (the sanitizer
+#: analogue of the flight recorder's "flink-tpu-flight").
+HB_LOG_KIND = "flink-tpu-sanitizer-log"
+
+#: Default happens-before ring capacity.  Events are ~6-tuple rows; at
+#: one event per wire frame / grant batch / handshake this covers long
+#: soaks, and the dump carries a ``truncated`` flag when it wrapped so
+#: the stitcher can skip prefix-dependent checks instead of lying.
+DEFAULT_HB_CAPACITY = 65536
 
 
 def env_enabled() -> bool:
@@ -100,6 +128,34 @@ def env_shake_seed() -> typing.Optional[int]:
     except ValueError:
         logger.warning("FLINK_TPU_SANITIZE_SHAKE=%r is not an int; ignored", raw)
         return None
+
+
+def env_hb_log_path() -> typing.Optional[str]:
+    """``FLINK_TPU_SANITIZE_LOG=<path>``: dump the happens-before event
+    log there at join/crash (distributed runs suffix ``.proc<k>``)."""
+    return os.environ.get("FLINK_TPU_SANITIZE_LOG") or None
+
+
+def env_hb_capacity() -> int:
+    raw = os.environ.get("FLINK_TPU_SANITIZE_HB_EVENTS")
+    if not raw:
+        return DEFAULT_HB_CAPACITY
+    try:
+        return max(16, int(raw))
+    except ValueError:
+        logger.warning(
+            "FLINK_TPU_SANITIZE_HB_EVENTS=%r is not an int; ignored", raw)
+        return DEFAULT_HB_CAPACITY
+
+
+def load_hb_log(path: str) -> dict:
+    """Load one per-process happens-before log, validating the marker."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("kind") != HB_LOG_KIND:
+        raise ValueError(f"{path}: not a sanitizer happens-before log "
+                         f"(kind={doc.get('kind') if isinstance(doc, dict) else type(doc).__name__!r})")
+    return doc
 
 
 @dataclasses.dataclass(frozen=True)
@@ -241,7 +297,8 @@ class ConcurrencySanitizer:
     def __init__(self, name: str = "job", *,
                  stall_timeout_s: typing.Optional[float] = None,
                  raise_on_cycle: bool = True,
-                 shake_seed: typing.Optional[int] = None):
+                 shake_seed: typing.Optional[int] = None,
+                 hb_capacity: typing.Optional[int] = None):
         self.name = name
         self.stall_timeout_s = (
             stall_timeout_s if stall_timeout_s is not None else env_stall_timeout_s()
@@ -285,6 +342,28 @@ class ConcurrencySanitizer:
         self._gate_blocked: typing.Dict[str, typing.Set[int]] = {}
         #: (subtask scope, checkpoint id) -> next expected chain position.
         self._chain_pos: typing.Dict[typing.Tuple[str, int], int] = {}
+        # -- cross-process happens-before log -----------------------------
+        #: Bounded ring of compact event rows
+        #: ``(kind, t_monotonic, edge, conn, seq, args_or_None)``.
+        #: Appended lock-free (deque.append is GIL-atomic) from reactor /
+        #: writer / source threads; the per-key sequence counters are
+        #: single-writer by construction (one thread owns each
+        #: (kind, edge, conn) stream), so no mutex rides the hot path.
+        self._hb: typing.Deque[tuple] = collections.deque(
+            maxlen=hb_capacity if hb_capacity is not None else env_hb_capacity())
+        self._hb_seq: typing.Dict[tuple, int] = {}
+        #: Total events ever recorded; ``recorded > len(ring)`` in a dump
+        #: flags truncation so the stitcher skips prefix-dependent
+        #: checks rather than reporting phantom violations.
+        self._hb_recorded = 0
+        #: Cohort identity mirrored from the tracer's block by the
+        #: telemetry service (process_index, pid, offset_to_proc0_s,
+        #: error_bound_s) — lets the stitcher order THIS log's events on
+        #: the process-0 timebase even when tracing is off.
+        self.cohort_meta: typing.Optional[dict] = None
+        #: dump reasons already written (idempotent like the flight
+        #: recorder: join after a crash dump must not clobber it).
+        self._hb_dumped: typing.Set[str] = set()
         #: observability counters (runtime exposes them as gauges).
         self.progress_ops = 0
         self._watchdog: typing.Optional[threading.Thread] = None
@@ -409,10 +488,12 @@ class ConcurrencySanitizer:
     def gate_channel_blocked(self, gate: str, idx: int) -> None:
         with self._mu:
             self._gate_blocked.setdefault(gate, set()).add(idx)
+        self.hb("align.block", gate, str(idx))
 
     def gate_unblocked(self, gate: str) -> None:
         with self._mu:
             self._gate_blocked.pop(gate, None)
+        self.hb("align.unblock", gate)
 
     def gate_delivered(self, gate: str, idx: int) -> None:
         """An element left the gate toward the operator on channel
@@ -469,6 +550,110 @@ class ConcurrencySanitizer:
                              "in-flight-split snapshots"),
                     thread=threading.current_thread().name,
                 ))
+
+    # -- cross-process happens-before log ----------------------------------
+    def hb(self, kind: str, edge: str = "", conn: str = "",
+           **args: typing.Any) -> int:
+        """Append one happens-before event; returns this event's
+        per-(kind, edge, conn) sequence number.
+
+        Event vocabulary (the stitcher's contract — see
+        core/sanitizer_stitch.py):
+
+        - ``frame.send`` / ``frame.recv`` — one wire frame left / hit an
+          edge's transport (args: fc class, bytes, in-frame barrier ids);
+        - ``frame.deliver`` — a route put records into its input gate
+          (args: gate, ch, data flag) — the event the alignment and
+          epoch-fence checks key on;
+        - ``frame.stale_drop`` — a zombie epoch's frame was fenced;
+        - ``epoch.handshake`` — either end of a record-plane connection
+          (args: role, epoch, server_epoch, stale, gate);
+        - ``credit.grant`` / ``credit.recv_grant`` / ``credit.spend`` /
+          ``credit.park`` / ``credit.unpark`` — the flow-control ledger,
+          generation-tagged;
+        - ``gate.full`` / ``gate.resume`` — receiver-side backpressure
+          transitions (the deadlock check's receiver half);
+        - ``barrier.inject`` — a source emitted a checkpoint barrier;
+        - ``align.block`` / ``align.unblock`` — barrier-alignment windows
+          (recorded by the gate hooks above).
+
+        Lock-free: one dict bump + one deque append, so the capture cost
+        prices at tens of ns (bench.py's ``hb_record_ns`` row) and the
+        hook sites keep their single is-None guard when the sanitizer is
+        off.
+        """
+        key = (kind, edge, conn)
+        seq = self._hb_seq.get(key, 0)
+        self._hb_seq[key] = seq + 1
+        self._hb.append(
+            (kind, time.monotonic(), edge, conn, seq, args or None))
+        self._hb_recorded += 1
+        return seq
+
+    @property
+    def hb_events(self) -> int:
+        """Events currently held in the ring."""
+        return len(self._hb)
+
+    @property
+    def hb_recorded(self) -> int:
+        """Events ever recorded (>= hb_events once the ring wraps)."""
+        return self._hb_recorded
+
+    @property
+    def hb_dropped(self) -> int:
+        """Events lost to ring truncation."""
+        return max(0, self._hb_recorded - len(self._hb))
+
+    def dump_hb_log(self, path: typing.Optional[str], reason: str,
+                    *, extra: typing.Optional[dict] = None
+                    ) -> typing.Optional[str]:
+        """Write the happens-before log (+ any recorded violations) as
+        one JSON document — atomic tmp+replace, idempotent per reason
+        like the flight recorder.  Returns the path written (or already
+        written for this reason), None when no path is configured."""
+        if not path:
+            return None
+        if reason in self._hb_dumped:
+            return path
+        self._hb_dumped.add(reason)
+        events = [list(ev) for ev in list(self._hb)]
+        recorded = self._hb_recorded
+        doc = {
+            "kind": HB_LOG_KIND,
+            "version": 1,
+            "name": self.name,
+            "pid": os.getpid(),
+            "reason": reason,
+            "wall_time": time.time(),
+            "cohort": self.cohort_meta,
+            "recorded": recorded,
+            "truncated": recorded > len(events),
+            "violations": [
+                {"kind": v.kind, "message": v.message, "thread": v.thread}
+                for v in self.violations
+            ],
+            "events": events,
+        }
+        if extra:
+            doc["extra"] = extra
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError as exc:
+            logger.warning("sanitizer hb-log dump to %s failed: %s", path, exc)
+            self._hb_dumped.discard(reason)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        logger.info("sanitizer[%s] happens-before log (%d events%s) "
+                    "dumped to %s (reason: %s)", self.name, len(events),
+                    ", truncated" if doc["truncated"] else "", path, reason)
+        return path
 
     # -- recording / reporting ---------------------------------------------
     def _record_locked(self, v: Violation) -> None:

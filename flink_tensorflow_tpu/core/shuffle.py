@@ -53,6 +53,7 @@ posture inside a Flink cluster.
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
 import os
 import pickle
@@ -124,6 +125,20 @@ def credit_window(channel_capacity: int) -> int:
     ``window × flush_bytes`` while staying deep enough to keep the pipe
     busy across the grant round-trip."""
     return max(2, min(32, channel_capacity // 32))
+
+
+_conn_seq = itertools.count(1)
+
+
+def _new_conn_id() -> str:
+    """Cohort-unique record-plane connection id (pid + process-local
+    counter), shipped in the handshake ``opts`` when the sanitizer is
+    on so both ends' happens-before logs name the SAME connection —
+    the stitcher pairs per-connection send/recv sequence numbers on it.
+    Reconnects mint a fresh id: a resent frame opens a new sequence
+    space instead of colliding with the dead transport's."""
+    return f"{os.getpid()}:{next(_conn_seq)}"
+
 
 _RING_NOTIFY_WIRE: typing.Optional[bytes] = None
 
@@ -375,6 +390,16 @@ class _ServerRoute:
     def __init__(self, server: "ShuffleServer", sock: socket.socket):
         self.server = server
         self.route = "<handshake>"
+        #: Suffix-free edge name (``task.subtask[chN]`` — identical to
+        #: the sender's) for the sanitizer happens-before log; the
+        #: display ``route`` accretes [shm]/[stale-epoch-N] markers.
+        self.edge = self.route
+        #: Sanitizer hand-off (None in production: one is-None test per
+        #: hook site) + the sender-minted connection id from the
+        #: handshake, pairing this route's events with the peer's.
+        self._san = server.sanitizer
+        self._hb_conn = ""
+        self._hb_stalled = False
         self.task: typing.Optional[str] = None
         self.subtask_index = -1
         self.channel_idx = -1
@@ -420,6 +445,8 @@ class _ServerRoute:
             return self._handshake(obj)
         if self.stale:
             self.server.count_stale_frame()
+            if self._san is not None:
+                self._san.hb("frame.stale_drop", self.edge, self._hb_conn)
             return True  # fenced: drop everything from the zombie epoch
         if self.is_control:
             if self.server.on_control is not None:
@@ -439,9 +466,16 @@ class _ServerRoute:
     def _handshake(self, hello) -> bool:
         self.task, self.subtask_index, self.channel_idx = hello[0], hello[1], hello[2]
         self.route = f"{self.task}.{self.subtask_index}[ch{self.channel_idx}]"
+        self.edge = self.route
         opts = (hello[3] if len(hello) > 3 and isinstance(hello[3], dict)
                 else {})
         peer_epoch = opts.get("epoch", 0)
+        if self._san is not None and self.task != ShuffleServer.CONTROL_TASK:
+            self._hb_conn = str(opts.get("conn", ""))
+            self._san.hb("epoch.handshake", self.edge, self._hb_conn,
+                         role="recv", epoch=peer_epoch,
+                         server_epoch=self.server.epoch,
+                         stale=peer_epoch < self.server.epoch)
         if peer_epoch < self.server.epoch:
             # Zombie sender from before the cohort restart: fence it.
             # The connection stays open (a raise would look like OUR
@@ -518,6 +552,13 @@ class _ServerRoute:
             if n:
                 self._records.inc(n)
                 self._bytes.inc(nbytes)
+        if self._san is not None:
+            barriers = [e.checkpoint_id for e in elements
+                        if isinstance(e, el.CheckpointBarrier)]
+            args: typing.Dict[str, typing.Any] = {"nbytes": nbytes}
+            if barriers:
+                args["barriers"] = barriers
+            self._san.hb("frame.recv", self.edge, self._hb_conn, **args)
         self.pending.extend(elements)
 
     def _drain(self) -> bool:
@@ -531,9 +572,29 @@ class _ServerRoute:
                     self.pending.popleft()
                     if type(element) is el.EndOfPartition:
                         self.saw_eop = True
+                san = self._san
+                if san is not None and taken:
+                    # The conformance event for the epoch-fence and
+                    # blocked-channel checks: records REACHED the gate
+                    # (arrival alone is legal — alignment parks frames
+                    # in `pending`, zombies drop before ingest).
+                    san.hb("frame.deliver", self.edge, self._hb_conn,
+                           gate=getattr(self.gate, "_san_name", ""),
+                           ch=self.channel_idx, n=taken,
+                           data=any(type(e) is el.StreamRecord
+                                    for e in batch[:taken]))
+                    if self._hb_stalled:
+                        self._hb_stalled = False
+                        san.hb("gate.resume", self.edge, self._hb_conn)
                 if taken < len(batch):
                     if self._gate_paused is not None:
                         self._gate_paused.inc()
+                    if san is not None and not self._hb_stalled:
+                        # Receiver half of the distributed-deadlock
+                        # check: this edge's delivery is parked on a
+                        # full gate until gate.resume.
+                        self._hb_stalled = True
+                        san.hb("gate.full", self.edge, self._hb_conn)
                     return False
             if self.ring is None:
                 self._maybe_grant()
@@ -573,6 +634,11 @@ class _ServerRoute:
             self.conn.send(parts, block=False)
         if self._credit_grants is not None:
             self._credit_grants.inc(n)
+        if self._san is not None:
+            # Receiver side of the credit ledger: the stitcher's
+            # overspend check compares the peer's spends against the
+            # sum of these grants per connection.
+            self._san.hb("credit.grant", self.edge, self._hb_conn, n=n)
 
     def _maybe_grant(self) -> None:
         """Replenish credits for frames whose elements all reached the
@@ -720,11 +786,16 @@ class ShuffleServer:
                  on_control: typing.Optional[typing.Callable[[int, typing.Any], None]] = None,
                  metrics: typing.Optional[typing.Any] = None,
                  reactor: typing.Optional[Reactor] = None,
-                 epoch: int = 0):
+                 epoch: int = 0,
+                 sanitizer: typing.Optional[typing.Any] = None):
         #: Restart-epoch fence (DistributedConfig.restart_epoch): a
         #: handshake carrying an older epoch marks a zombie sender from
         #: a previous incarnation of the cohort; its frames are dropped.
         self.epoch = epoch
+        #: ConcurrencySanitizer (or None): routes append happens-before
+        #: events (handshakes, frame recv/deliver, grants, stale drops)
+        #: for the cohort-wide conformance stitcher.
+        self.sanitizer = sanitizer
         self._stale_frames = None  # lazy Counter (reactor single-writer)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -867,7 +938,8 @@ class RemoteChannelWriter:
                  epoch: int = 0,
                  reconnect_timeout_s: float = 5.0,
                  fault_hook: typing.Optional[typing.Callable[[], typing.Optional[str]]] = None,
-                 flow_control: bool = False):
+                 flow_control: bool = False,
+                 sanitizer: typing.Optional[typing.Any] = None):
         self.host = host
         self.port = port
         self.task = task
@@ -918,6 +990,14 @@ class RemoteChannelWriter:
         self.shm_ring_bytes = shm_ring_bytes
         self._reactor = reactor
         self._tracer = tracer
+        #: Sanitizer happens-before hooks (None in production): this
+        #: writer logs the SEND half of every record-plane interaction —
+        #: handshake epoch, per-connection frame sequence, credit
+        #: spends/parks — under the same edge name the receiving route
+        #: logs, so the stitcher can pair both ends.
+        self._san = sanitizer
+        self._edge = f"{task}.{subtask_index}[ch{channel_idx}]"
+        self._hb_conn = ""
         #: Trace track: the edge's DESTINATION subtask — wire spans land
         #: under the operator the frames feed, mirroring RemoteSink's
         #: attribution (and the `<op>.<index>` shape the attribution
@@ -998,6 +1078,12 @@ class RemoteChannelWriter:
             aborted=lambda: self._closed,
         )
         opts: typing.Dict[str, typing.Any] = {"epoch": self.epoch}
+        if self._san is not None:
+            # Fresh connection id per transport incarnation: a
+            # reconnect's resent frames open a new sequence space on
+            # both ends instead of colliding with the dead one's.
+            self._hb_conn = _new_conn_id()
+            opts["conn"] = self._hb_conn
         if self.shm:
             path = os.path.join(
                 shm_dir(),
@@ -1015,6 +1101,9 @@ class RemoteChannelWriter:
             opts["fc"] = True
         _send_obj(self._sock,
                   (self.task, self.subtask_index, self.channel_idx, opts))
+        if self._san is not None:
+            self._san.hb("epoch.handshake", self._edge, self._hb_conn,
+                         role="send", epoch=self.epoch, fc=bool(fc))
         with self._fc_cv:
             # New transport generation: credits restart at zero and wait
             # on the NEW route's initial grant; grant callbacks bound to
@@ -1202,7 +1291,10 @@ class RemoteChannelWriter:
         # Lone control elements (barrier / watermark / EOP) BYPASS
         # credit: a zero-credit edge must still align and terminate.
         # The receiver's replenish accounting mirrors this exactly.
-        self._send_parts(parts, payload_bytes, fc="bypass")
+        self._send_parts(parts, payload_bytes, fc="bypass",
+                         barriers=([element.checkpoint_id]
+                                   if isinstance(element, el.CheckpointBarrier)
+                                   else None))
         if self._records is not None and isinstance(element, el.StreamRecord):
             self._records.inc()
             self._bytes.inc(payload_bytes)
@@ -1217,7 +1309,8 @@ class RemoteChannelWriter:
             tracer.span(self._track, "wire", t1, t2,
                         args={"bytes": payload_bytes})
 
-    def _send_parts(self, parts, payload_bytes: int, fc: str = "data") -> None:
+    def _send_parts(self, parts, payload_bytes: int, fc: str = "data",
+                    barriers: typing.Optional[typing.List[int]] = None) -> None:
         try:
             if self._fault_hook is not None and self._fault_hook() == "drop":
                 return  # injected blackhole: the frame vanishes on the wire
@@ -1237,8 +1330,24 @@ class RemoteChannelWriter:
             if self._closed:
                 return
             if self._reconnect_and_resend(parts):
+                self._hb_frame_sent(fc, payload_bytes, barriers)
                 return
             raise  # peer loss surfaces as subtask failure -> job failure
+        else:
+            # Logged only when the frame actually hit the transport:
+            # dropped (fault-injected) frames book NEITHER a send event
+            # nor a credit, so the stitched ledgers balance under chaos.
+            self._hb_frame_sent(fc, payload_bytes, barriers)
+
+    def _hb_frame_sent(self, fc: str, payload_bytes: int,
+                       barriers: typing.Optional[typing.List[int]]) -> None:
+        if self._san is None:
+            return
+        args: typing.Dict[str, typing.Any] = {"fc": fc,
+                                              "nbytes": payload_bytes}
+        if barriers:
+            args["barriers"] = barriers
+        self._san.hb("frame.send", self._edge, self._hb_conn, **args)
 
     def _transmit(self, parts) -> None:
         if self._ring is not None:
@@ -1299,6 +1408,10 @@ class RemoteChannelWriter:
             with self._fc_cv:
                 if gen == self._fc_gen:
                     self._fc_credits += int(obj[1])
+                    if self._san is not None:
+                        self._san.hb("credit.recv_grant", self._edge,
+                                     self._hb_conn, gen=gen, n=int(obj[1]),
+                                     balance=self._fc_credits)
                     self._fc_cv.notify_all()
         return True
 
@@ -1324,6 +1437,7 @@ class RemoteChannelWriter:
             self._fc_acquire_ring(floor)
             return
         t0 = None
+        san = self._san
         with self._fc_cv:
             gen = self._fc_gen
             while (self._fc_credits <= floor and not self._closed
@@ -1331,10 +1445,26 @@ class RemoteChannelWriter:
                    and self._conn is not None and not self._conn.closed):
                 if t0 is None:
                     t0 = time.monotonic()
+                    if san is not None:
+                        # Sender half of the distributed-deadlock check:
+                        # parked at the floor until credit.unpark.
+                        san.hb("credit.park", self._edge, self._hb_conn,
+                               gen=gen, floor=floor)
                 self._fc_cv.wait(0.05)
             if t0 is not None:
-                self._fc_starved_s += time.monotonic() - t0
+                waited = time.monotonic() - t0
+                self._fc_starved_s += waited
+                if san is not None:
+                    san.hb("credit.unpark", self._edge, self._hb_conn,
+                           gen=gen, waited_s=waited)
             self._fc_credits -= 1
+            if san is not None:
+                # Self-contained ledger row (balance AFTER the spend vs
+                # the mode's floor): the overspend check survives ring
+                # truncation because each row carries its own invariant.
+                san.hb("credit.spend", self._edge, self._hb_conn,
+                       gen=self._fc_gen, balance=self._fc_credits,
+                       floor=floor)
         if self._tracer is not None and t0 is not None:
             self._tracer.span(self._track, "wire.credit_wait",
                               t0, time.monotonic())
@@ -1345,6 +1475,8 @@ class RemoteChannelWriter:
         contract the ring cursors already rely on).  Backoff-sleep while
         starved; close / ring teardown break the loop."""
         t0 = None
+        san = self._san
+        spent = False
         while not self._closed:
             ring = self._ring
             if ring is None:
@@ -1355,16 +1487,29 @@ class RemoteChannelWriter:
                 break  # torn down under us: let the write path fail loudly
             if self._fc_ring_spent < granted - floor:
                 self._fc_ring_spent += 1
+                spent = True
                 break
             if t0 is None:
                 t0 = time.monotonic()
+                if san is not None:
+                    san.hb("credit.park", self._edge, self._hb_conn,
+                           gen=self._fc_gen, floor=floor)
             time.sleep(0.0005)
         if t0 is not None:
             dt = time.monotonic() - t0
             self._fc_starved_s += dt
+            if san is not None:
+                san.hb("credit.unpark", self._edge, self._hb_conn,
+                       gen=self._fc_gen, waited_s=dt)
             if self._tracer is not None:
                 self._tracer.span(self._track, "wire.credit_wait",
                                   t0, t0 + dt)
+        if san is not None and spent:
+            # Ring ledger: balance = cumulative grants minus cumulative
+            # spends (the ring's credit cell IS the grant counter).
+            san.hb("credit.spend", self._edge, self._hb_conn,
+                   gen=self._fc_gen,
+                   balance=granted - self._fc_ring_spent, floor=floor)
 
     def _reconnect_and_resend(self, parts) -> bool:
         """Exponential-backoff reconnect after a transport failure,

@@ -128,6 +128,8 @@ class RemoteSink(fn.SinkFunction):
         self._wire: typing.Optional[str] = self.wire_dtype
         self._sock: typing.Optional[socket.socket] = None
         self._tracer = None
+        self._san = None
+        self._hb_edge = ""
         self._track: typing.Optional[str] = None
         self._saved_counter = None
         self._lock = threading.Lock()
@@ -175,6 +177,15 @@ class RemoteSink(fn.SinkFunction):
 
         self._tracer = getattr(ctx, "tracer", None)
         self._track = f"{ctx.task_name}.{ctx.subtask_index}"
+        # Distributed sanitizer: the job-to-job pipe logs its half of
+        # each happens-before edge.  The edge name is directional and
+        # sink-local on purpose — the pipe has no conn handshake, so the
+        # stitcher must never pair these with the receiving job's log
+        # (pairing without a shared conn id would be a false positive
+        # factory); they enrich the per-process dump and local checks.
+        self._san = getattr(ctx, "sanitizer", None)
+        self._hb_edge = (f"{ctx.task_name}.{ctx.subtask_index}"
+                         f"->{self.host}:{self.port}")
         self._wire = (self.wire_dtype
                       if self.wire_dtype is not None
                       else getattr(ctx, "wire_dtype", None))
@@ -317,20 +328,31 @@ class RemoteSink(fn.SinkFunction):
             if self._fc_state != "on":
                 return  # still probing: send credit-free, keep listening
         floor = -CREDIT_OVERFLOW_FRAMES if fc == "align" else 0
+        san = self._san
         self._harvest_grants(0.0)
         if self._fc_credits > floor:
             self._fc_credits -= 1
+            if san is not None:
+                san.hb("credit.spend", self._hb_edge,
+                       balance=self._fc_credits, floor=floor)
             return
         t0 = time.monotonic()
+        if san is not None:
+            san.hb("credit.park", self._hb_edge, floor=floor)
         while self._fc_credits <= floor:
             if not self._harvest_grants(0.05):
                 break  # peer gone; the send path reconnects (or raises)
         waited = time.monotonic() - t0
         self._credit_starved_s += waited
+        if san is not None:
+            san.hb("credit.unpark", self._hb_edge, waited_s=waited)
         if self._tracer is not None and waited > 1e-3:
             self._tracer.span(self._track, "wire.credit_wait",
                               t0, time.monotonic(), args={"mode": fc})
         self._fc_credits -= 1
+        if san is not None:
+            san.hb("credit.spend", self._hb_edge,
+                   balance=self._fc_credits, floor=floor)
 
     def invoke(self, value) -> None:
         if not isinstance(value, TensorValue):
@@ -480,6 +502,9 @@ class RemoteSink(fn.SinkFunction):
                 return
             self._fc_acquire(fc)
             _sendall_parts(self._sock, parts)
+            if self._san is not None:
+                self._san.hb("frame.send", self._hb_edge, fc=fc,
+                             nbytes=sum(len(p) for p in parts))
             return
         except (OSError, ConnectionError):
             try:
@@ -508,6 +533,10 @@ class RemoteSink(fn.SinkFunction):
                 self._reset_after_reconnect()
                 self._fc_acquire(fc)
                 _sendall_parts(self._sock, parts)
+                if self._san is not None:
+                    self._san.hb("frame.send", self._hb_edge, fc=fc,
+                                 nbytes=sum(len(p) for p in parts),
+                                 resend=True)
             except (OSError, ConnectionError, TimeoutError):
                 if self._sock is not None:
                     try:
@@ -620,8 +649,12 @@ class RemoteSource(fn.SourceFunction):
         #: hand-off queue (its backlog is the per-connection parser).
         self.queue_capacity = queue_capacity
         self._tracer = None
+        self._san = None
+        self._hb_edge = ""
         self._track: typing.Optional[str] = None
         self._credit_grants = None
+        self._wire_latency = None
+        self._wire_latency_err = 0.0
 
     def clone(self):
         return self  # the listener is the identity; parallelism must be 1
@@ -629,14 +662,48 @@ class RemoteSource(fn.SourceFunction):
     def open(self, ctx) -> None:
         self._tracer = getattr(ctx, "tracer", None)
         self._track = f"{ctx.task_name}.{ctx.subtask_index}"
+        # Directional receive-side edge name; deliberately distinct from
+        # any sender's edge so the cohort stitcher never pairs the
+        # conn-less pipe (see RemoteSink.open).
+        self._san = getattr(ctx, "sanitizer", None)
+        self._hb_edge = f"remote:{self.port}->{self._track}"
         if ctx.metrics is not None:
             self._credit_grants = ctx.metrics.counter("credit_grants")
+            # One-way wire latency per remote edge (send stamp rides the
+            # __trace__ meta; mapped into this clock via the cohort
+            # offsets) with the estimation error bound published beside
+            # it — a reading is only as trustworthy as its bound.
+            self._wire_latency = ctx.metrics.histogram("edge.wire_latency_s")
+            ctx.metrics.gauge("edge.wire_latency_err_s",
+                              lambda: self._wire_latency_err)
         if ctx.parallelism != 1:
             raise RuntimeError(
                 "RemoteSource owns one listener — run it with "
                 f"parallelism=1 (got {ctx.parallelism}); scale ingest by "
                 "raising fan_in instead"
             )
+
+    def _record_wire_latency(self, record, t_recv: float) -> None:
+        """One-way send->recv latency for a decoded frame, read off the
+        first record's ``__trace__`` stamp (peeked, not popped — the
+        admitting source still re-admits the trace).  Recorded only once
+        the cohort clock sync knows the origin's offset; the current
+        error bound is published alongside so a reading smaller than its
+        bound is visibly noise, not signal."""
+        hist = self._wire_latency
+        tracer = self._tracer
+        if hist is None or tracer is None:
+            return
+        meta = getattr(record, "meta", None)
+        stamp = meta.get("__trace__") if meta else None
+        if type(stamp) is not tuple:
+            return
+        _trace_id, origin, t_send = stamp
+        off = tracer.clock_offsets.get(origin)
+        if off is None or not t_send:
+            return
+        hist.record(max(0.0, t_recv - (t_send + off)))
+        self._wire_latency_err = tracer.clock_error.get(origin, 0.0)
 
     def run(self) -> typing.Iterator[typing.Any]:
         """Yields records; yields SOURCE_IDLE while waiting (accepting or
@@ -671,10 +738,14 @@ class RemoteSource(fn.SourceFunction):
         grant_out: typing.Dict[socket.socket, bytearray] = {}
         grants_counter = self._credit_grants
 
+        san = self._san
+
         def queue_grant(conn: socket.socket, n: int) -> None:
             grant_out.setdefault(conn, bytearray()).extend(_GRANT.pack(n))
             if grants_counter is not None:
                 grants_counter.inc(n)
+            if san is not None:
+                san.hb("credit.grant", self._hb_edge, n=n)
 
         def flush_grants() -> None:
             for c in list(grant_out):
@@ -817,6 +888,9 @@ class RemoteSource(fn.SourceFunction):
                             # One credit per data frame, owed back once
                             # its records are yielded downstream.
                             unacked[conn] = unacked.get(conn, 0) + 1
+                        if san is not None:
+                            san.hb("frame.recv", self._hb_edge,
+                                   nbytes=length)
                         if tracer is None:
                             ready.extend(decode_frame(payload))
                         else:
@@ -826,6 +900,8 @@ class RemoteSource(fn.SourceFunction):
                                         time.monotonic(),
                                         args={"bytes": length,
                                               "records": len(records)})
+                            if records:
+                                self._record_wire_latency(records[0], t0)
                             ready.extend(records)
             while ready:
                 yield ready.popleft()

@@ -275,6 +275,14 @@ def default_rules(*, channel_capacity: int = 1024) -> typing.Tuple[SloRule, ...]
         SloRule("credit-starvation-shuffle", "credit_starved_s",
                 scope="shuffle.out.*", warn=0.5, breach=0.85,
                 mode="rate", action="scale_up"),
+        # One-way wire latency (p95) on remote record-plane edges:
+        # send->recv delta via the cohort clock offsets (io/remote.py's
+        # `edge.wire_latency_s`, error bound published next to it).  A
+        # creeping p95 is the wire-side early warning the queue-depth
+        # rules can't see — frames aging in kernel buffers before the
+        # receiver ever books them.
+        SloRule("wire-latency", "edge.wire_latency_s", field="p95",
+                warn=0.5, breach=2.0, sustain=2, action="scale_up"),
         # Sustained idleness = over-provisioned (scale-down hint); long
         # sustain so startup/drain phases don't trip it.
         SloRule("idle", "idle_s", warn=0.90, breach=0.99, mode="rate",

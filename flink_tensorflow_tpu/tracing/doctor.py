@@ -220,19 +220,43 @@ def supervisor_actions(flight_docs: typing.Sequence[dict],
     return actions
 
 
+def sanitizer_findings(report: typing.Optional[dict]
+                       ) -> typing.List[str]:
+    """Distributed-sanitizer conformance violations folded into doctor
+    findings.  A protocol violation is PROVEN misbehaviour — it outranks
+    every statistical signal, so the caller places these first."""
+    if not report:
+        return []
+    out: typing.List[str] = []
+    for v in report.get("violations", ()):
+        edge = f" on edge {v['edge']}" if v.get("edge") else ""
+        out.append(f"sanitizer: {v.get('kind', 'violation')}{edge} — "
+                   f"{v.get('message', '')}")
+    for v in report.get("local_violations", ()):
+        out.append(f"sanitizer (process {v.get('process')}): "
+                   f"{v.get('kind', 'violation')} — {v.get('message', '')}")
+    if not out and report.get("truncated"):
+        out.append("sanitizer: no violation, but event logs were "
+                   "truncated — prefix-dependent checks were skipped")
+    return out
+
+
 def diagnose(
     snapshot: typing.Optional[Snapshot] = None,
     *,
     events: typing.Sequence[tuple] = (),
     flight_docs: typing.Sequence[dict] = (),
     decision: typing.Optional[dict] = None,
+    sanitizer_report: typing.Optional[dict] = None,
     channel_capacity: int = 1024,
     top: int = 3,
 ) -> typing.Dict[str, typing.Any]:
     """The full correlation: returns the report dict the CLI prints.
     ``findings`` is the ranked human-readable summary — finding 1 names
     the breached rule, the bottleneck operator, its dominant stage, and
-    what (if anything) the supervisor did about it."""
+    what (if anything) the supervisor did about it.  A distributed-
+    sanitizer report (``flink-tpu-sanitize --out``) contributes proven
+    protocol violations, ranked above everything else."""
     snapshot = snapshot or {}
     rules = health_findings(snapshot, channel_capacity=channel_capacity)
     bottlenecks = [b for b in bottleneck_ranking(snapshot)
@@ -241,8 +265,9 @@ def diagnose(
                    or b.get("credit_starved_s", 0) > 0]
     stages = stage_dominance(events)
     actions = supervisor_actions(flight_docs, decision)
+    san_findings = sanitizer_findings(sanitizer_report)
 
-    findings: typing.List[str] = []
+    findings: typing.List[str] = list(san_findings)
     named: typing.Set[str] = set()
     for rank, b in enumerate(bottlenecks[:top], start=1):
         op = b["operator"]
@@ -299,6 +324,7 @@ def diagnose(
         "bottlenecks": bottlenecks,
         "stages": stages,
         "actions": actions,
+        "sanitizer": san_findings,
     }
 
 
@@ -343,6 +369,11 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     parser.add_argument("--decision", default=None, metavar="DECISION.json",
                         help="autoscale decision file written by the "
                              "actuator")
+    parser.add_argument("--sanitizer", default=None, metavar="REPORT.json",
+                        help="distributed-sanitizer report "
+                             "(flink-tpu-sanitize --out): proven protocol "
+                             "violations rank above every statistical "
+                             "signal")
     parser.add_argument("--channel-capacity", type=int, default=1024,
                         help="channel capacity the queue-depth thresholds "
                              "scale against (default 1024)")
@@ -357,6 +388,7 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     snapshot: typing.Optional[Snapshot] = None
     events: typing.List[tuple] = []
     flight_docs: typing.List[dict] = []
+    sanitizer_report: typing.Optional[dict] = None
     loaded = 0
     try:
         if args.snapshot:
@@ -380,6 +412,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                 events.extend(doc.get("events", ()))
                 events.extend(doc.get("tracer_events", ()))
                 loaded += 1
+        if args.sanitizer:
+            from flink_tensorflow_tpu.core.sanitizer_stitch import (
+                load_report,
+            )
+
+            sanitizer_report = load_report(args.sanitizer)
+            loaded += 1
     except (OSError, ValueError) as ex:
         print(f"flink-tpu-doctor: unreadable evidence: {ex}",
               file=sys.stderr)
@@ -396,12 +435,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
         loaded += 1
     if not loaded:
         parser.error("provide at least one of --snapshot / --flight / "
-                     "--trace / --decision")
+                     "--trace / --decision / --sanitizer")
     events.sort(key=lambda ev: ev[3])
 
     report = diagnose(
         snapshot, events=events, flight_docs=flight_docs,
-        decision=decision, channel_capacity=args.channel_capacity,
+        decision=decision, sanitizer_report=sanitizer_report,
+        channel_capacity=args.channel_capacity,
         top=args.top,
     )
     print("== flink-tpu-doctor ==")

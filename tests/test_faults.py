@@ -570,10 +570,14 @@ class TestCohortChaosSoak:
 
         # Round 2: restart the cohort (fresh processes = restart epoch 1
         # for fencing purposes; the fault env var is gone) from the
-        # latest common checkpoint, sanitizer on.
+        # latest common checkpoint, sanitizer on WITH the distributed
+        # happens-before log — each worker dumps hb.proc<k>.json at join
+        # and the stitcher must find the soak protocol-conformant.
+        hb_base = str(tmp_path / "hb.json")
         ports2 = _free_ports(2)
         procs = [
-            spawn(i, ports2, {"FLINK_TPU_SANITIZE": "1"},
+            spawn(i, ports2, {"FLINK_TPU_SANITIZE": "1",
+                              "FLINK_TPU_SANITIZE_LOG": hb_base},
                   restore_id=common)
             for i in range(2)
         ]
@@ -587,6 +591,23 @@ class TestCohortChaosSoak:
             for r in read_committed(out)
         )
         assert got == expected_emissions(n)
+        # Distributed conformance: stitch the per-process hb logs and run
+        # all five cross-process checks — zero violations alongside the
+        # byte-identical output, and the record plane actually exercised
+        # (frames + credits on the stitched timeline).
+        from flink_tensorflow_tpu.core.sanitizer_rt import load_hb_log
+        from flink_tensorflow_tpu.core.sanitizer_stitch import stitch
+
+        docs = [load_hb_log(str(tmp_path / f"hb.proc{i}.json"))
+                for i in range(2)]
+        assert all(doc["reason"] == "shutdown" for doc in docs)
+        report = stitch(docs)
+        assert report["violations"] == [], report["violations"]
+        assert report["local_violations"] == []
+        kinds = {row[0] for doc in docs for row in doc["events"]}
+        assert "frame.send" in kinds and "frame.recv" in kinds
+        assert "credit.grant" in kinds
+        assert "epoch.handshake" in kinds
 
     def test_stall_delay_soak_flow_control_bounds_sender_queue(self, tmp_path):
         """Flow-control chaos-soak arm: a 2-process cohort runs the keyed

@@ -1,0 +1,487 @@
+"""Distributed sanitizer tests (PR 15).
+
+Three layers under test:
+
+- ``core/sanitizer_rt``'s happens-before plane: the bounded event ring,
+  the per-(kind, edge, conn) sequence numbers, the truncation flag, and
+  the atomic/idempotent ``dump_hb_log``.
+- ``core/sanitizer_stitch``: the cohort stitcher's five distributed
+  conformance checks, each proven live by a SEEDED protocol mutation —
+  a dropped epoch fence, a frame delivered past the granted credit
+  window, a barrier reordered behind a data frame, a delivery from an
+  alignment-blocked channel, a cross-process waits-for cycle — and
+  proven quiet by a healthy synthesized cohort (zero violations) and by
+  a truncated ring (prefix-dependent checks SKIP instead of inventing
+  phantom violations).
+- The integration seams: a sanitized LocalExecutor job dumps its log at
+  join (cross-referencing the flight recorder's dump path), the
+  ``flink-tpu-sanitize`` CLI exits non-zero naming the violation kind
+  and edge, and ``flink-tpu-doctor --sanitizer`` ranks the violations
+  above every statistical finding.
+"""
+
+import copy
+import json
+import os
+import sys
+import tempfile
+
+import pytest
+
+sys.path.insert(0, ".")
+
+from flink_tensorflow_tpu import StreamExecutionEnvironment
+from flink_tensorflow_tpu.core.sanitizer_rt import (
+    HB_LOG_KIND,
+    ConcurrencySanitizer,
+    load_hb_log,
+)
+from flink_tensorflow_tpu.core import sanitizer_stitch as stitch_mod
+from flink_tensorflow_tpu.core.sanitizer_stitch import (
+    CHECKS,
+    REPORT_KIND,
+    load_report,
+    stitch,
+)
+
+EDGE = "dbl.0[ch0]"
+CONN = "1000:1"
+GATE = "dbl.0.gate"
+
+
+def _doc(proc, events, *, offset=0.0, err=0.0, truncated=False,
+         violations=()):
+    """One synthesized per-process happens-before log document, shaped
+    exactly like ``ConcurrencySanitizer.dump_hb_log`` writes it."""
+    return {
+        "kind": HB_LOG_KIND,
+        "version": 1,
+        "name": f"proc{proc}",
+        "pid": 1000 + proc,
+        "reason": "test",
+        "wall_time": 0.0,
+        "cohort": {
+            "process_index": proc,
+            "pid": 1000 + proc,
+            "offset_to_proc0_s": offset,
+            "error_bound_s": err,
+        },
+        "recorded": len(events) + (1 if truncated else 0),
+        "truncated": truncated,
+        "violations": list(violations),
+        "events": [list(e) for e in events],
+    }
+
+
+def healthy_cohort():
+    """A conformant 2-process exchange over one shuffle edge: handshake,
+    an 8-frame credit window, two data frames (the second carrying
+    barrier 1), an alignment window between them, and a full->resume
+    gate excursion.  Receiver clock runs 0.5 s AHEAD of process 0
+    (offset_to_proc0_s = -0.5) so the stitcher's offset shift is
+    actually exercised; true one-way latency is 1 ms per frame."""
+    sender = [
+        ("epoch.handshake", 10.0000, EDGE, CONN, 0,
+         {"role": "send", "epoch": 0, "fc": True}),
+        ("barrier.inject", 10.0010, "src.0", "", 0, {"cid": 1}),
+        ("credit.recv_grant", 10.0015, EDGE, CONN, 0,
+         {"gen": 0, "n": 8, "balance": 8}),
+        ("credit.spend", 10.0020, EDGE, CONN, 0,
+         {"gen": 0, "balance": 7, "floor": 0}),
+        ("frame.send", 10.0030, EDGE, CONN, 0,
+         {"fc": "data", "nbytes": 256}),
+        ("credit.spend", 10.0040, EDGE, CONN, 1,
+         {"gen": 0, "balance": 6, "floor": 0}),
+        ("frame.send", 10.0050, EDGE, CONN, 1,
+         {"fc": "data", "nbytes": 300, "barriers": [1]}),
+    ]
+    # Local stamps on the receiver sit +0.5 s from the reference frame:
+    # t_ref = t_local + (-0.5).
+    receiver = [
+        ("epoch.handshake", 10.5005, EDGE, CONN, 0,
+         {"role": "recv", "epoch": 0, "server_epoch": 0, "stale": False}),
+        ("credit.grant", 10.5008, EDGE, CONN, 0, {"n": 8}),
+        ("frame.recv", 10.5040, EDGE, CONN, 0, {"nbytes": 256}),
+        ("frame.deliver", 10.5045, EDGE, CONN, 0,
+         {"gate": GATE, "ch": 0, "n": 4, "data": True}),
+        ("gate.full", 10.5047, EDGE, CONN, 0, {}),
+        ("gate.resume", 10.5049, EDGE, CONN, 0, {}),
+        ("align.block", 10.5050, GATE, "0", 0, {}),
+        ("frame.recv", 10.5060, EDGE, CONN, 1,
+         {"nbytes": 300, "barriers": [1]}),
+        ("align.unblock", 10.5070, GATE, "", 0, {}),
+        ("frame.deliver", 10.5075, EDGE, CONN, 1,
+         {"gate": GATE, "ch": 0, "n": 4, "data": True}),
+    ]
+    return (_doc(0, sender, err=0.0),
+            _doc(1, receiver, offset=-0.5, err=0.0002))
+
+
+def _kinds(report):
+    return [v["kind"] for v in report["violations"]]
+
+
+# ---------------------------------------------------------------------------
+# The happens-before ring itself.
+# ---------------------------------------------------------------------------
+class TestHbRing:
+    def test_seq_numbers_are_per_kind_edge_conn(self):
+        san = ConcurrencySanitizer(name="t", hb_capacity=64)
+        assert san.hb("frame.send", "e1", "c1") == 0
+        assert san.hb("frame.send", "e1", "c1") == 1
+        assert san.hb("frame.send", "e1", "c2") == 0  # new conn, new space
+        assert san.hb("frame.recv", "e1", "c1") == 0  # new kind, new space
+        assert san.hb_events == 4 and san.hb_dropped == 0
+
+    def test_ring_bounds_and_truncation_flag(self, tmp_path):
+        san = ConcurrencySanitizer(name="t", hb_capacity=16)
+        for _ in range(40):
+            san.hb("frame.send", "e", "c", nbytes=1)
+        assert san.hb_events == 16
+        assert san.hb_recorded == 40
+        assert san.hb_dropped == 24
+        path = str(tmp_path / "hb.json")
+        assert san.dump_hb_log(path, "test") == path
+        doc = load_hb_log(path)
+        assert doc["truncated"] is True
+        assert doc["recorded"] == 40 and len(doc["events"]) == 16
+
+    def test_dump_is_idempotent_per_reason_and_carries_extra(self, tmp_path):
+        san = ConcurrencySanitizer(name="t", hb_capacity=16)
+        san.hb("frame.send", "e", "c")
+        path = str(tmp_path / "hb.json")
+        san.dump_hb_log(path, "crash", extra={"flight_dump": "f.json"})
+        san.hb("frame.send", "e", "c")  # must NOT clobber the crash dump
+        san.dump_hb_log(path, "crash")
+        doc = load_hb_log(path)
+        assert len(doc["events"]) == 1
+        assert doc["extra"] == {"flight_dump": "f.json"}
+
+    def test_load_rejects_non_log(self, tmp_path):
+        path = tmp_path / "not_a_log.json"
+        path.write_text(json.dumps({"kind": "something-else"}))
+        with pytest.raises(ValueError):
+            load_hb_log(str(path))
+
+
+# ---------------------------------------------------------------------------
+# Healthy cohort: all five checks pass, latency is offset-corrected.
+# ---------------------------------------------------------------------------
+class TestHealthyCohort:
+    def test_zero_violations(self):
+        report = stitch(list(healthy_cohort()))
+        assert report["kind"] == REPORT_KIND
+        assert report["violations"] == []
+        assert report["local_violations"] == []
+        assert not report["truncated"]
+        assert set(report["checks"]) == set(CHECKS)
+        assert all(v == "ok" for v in report["checks"].values())
+
+    def test_wire_latency_is_offset_corrected(self):
+        report = stitch(list(healthy_cohort()))
+        lat = report["edges"][EDGE]["wire_latency_s"]
+        # Raw deltas would be ~0.501 s; the -0.5 s offset shift must
+        # recover the true ~1 ms one-way latency.
+        assert lat["count"] == 2
+        assert 0.0005 < lat["mean"] < 0.002
+        assert 0.0005 < lat["max"] < 0.002
+        # Error bound = sum of both processes' bounds.
+        assert report["edges"][EDGE]["error_bound_s"] == pytest.approx(0.0002)
+
+    def test_edge_frame_accounting(self):
+        report = stitch(list(healthy_cohort()))
+        agg = report["edges"][EDGE]
+        assert agg["frames_sent"] == 2
+        assert agg["frames_recvd"] == 2
+        assert agg["bytes"] == 556
+
+
+# ---------------------------------------------------------------------------
+# Seeded protocol mutations — each conformance check must fire and NAME
+# the violation kind + edge.
+# ---------------------------------------------------------------------------
+class TestSeededMutations:
+    def test_dropped_epoch_fence_is_caught(self):
+        """The receiver acknowledged a stale epoch (zombie sender) but
+        its frames still reached the gate — the restart fence leaked."""
+        sender, receiver = healthy_cohort()
+        receiver = copy.deepcopy(receiver)
+        for row in receiver["events"]:
+            if row[0] == "epoch.handshake":
+                row[5] = {"role": "recv", "epoch": 0, "server_epoch": 1,
+                          "stale": True}
+        report = stitch([sender, receiver])
+        assert "dist-epoch-fence" in _kinds(report)
+        v = next(v for v in report["violations"]
+                 if v["kind"] == "dist-epoch-fence")
+        assert v["edge"] == EDGE and v["conn"] == CONN
+        assert report["checks"]["epoch-fence"] == "violation"
+
+    def test_unfenced_trailing_epoch_is_caught(self):
+        """The handshake trailed the server epoch yet the receiver never
+        fenced the connection."""
+        sender, receiver = healthy_cohort()
+        receiver = copy.deepcopy(receiver)
+        for row in receiver["events"]:
+            if row[0] == "epoch.handshake":
+                row[5] = {"role": "recv", "epoch": 0, "server_epoch": 2,
+                          "stale": False}
+        report = stitch([sender, receiver])
+        assert "dist-epoch-fence" in _kinds(report)
+
+    def test_frame_past_granted_credits_is_caught(self):
+        """One data frame delivered beyond the granted window: the
+        sender's ledger goes below its floor."""
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        sender["events"].extend([
+            ["credit.spend", 10.0060, EDGE, CONN, 2,
+             {"gen": 0, "balance": -1, "floor": 0}],
+            ["frame.send", 10.0070, EDGE, CONN, 2,
+             {"fc": "data", "nbytes": 64}],
+        ])
+        report = stitch([sender, receiver])
+        assert "dist-credit-overspend" in _kinds(report)
+        v = next(v for v in report["violations"]
+                 if v["kind"] == "dist-credit-overspend")
+        assert v["edge"] == EDGE
+        assert "below its floor" in v["message"]
+
+    def test_spend_total_past_grants_is_caught(self):
+        """Totals form of the overspend check: more spend rows on a
+        connection than the receiver ever granted."""
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        receiver = copy.deepcopy(receiver)
+        # Shrink the grant to 1 but keep the two (locally consistent)
+        # spends — only the cross-process ledger can see this.
+        for row in receiver["events"]:
+            if row[0] == "credit.grant":
+                row[5] = {"n": 1}
+        report = stitch([sender, receiver])
+        assert "dist-credit-overspend" in _kinds(report)
+        assert "outran the receiver's window" in " ".join(
+            v["message"] for v in report["violations"])
+
+    def test_barrier_reordered_behind_data_is_caught(self):
+        """The barrier rode frame 1 on the wire but the receiver saw it
+        on frame 0 — reordered against the data stream."""
+        sender, receiver = healthy_cohort()
+        receiver = copy.deepcopy(receiver)
+        for row in receiver["events"]:
+            if row[0] == "frame.recv" and row[4] == 0:
+                row[5] = {"nbytes": 256, "barriers": [1]}
+            elif row[0] == "frame.recv" and row[4] == 1:
+                row[5] = {"nbytes": 300}
+        report = stitch([sender, receiver])
+        assert "dist-barrier-reorder" in _kinds(report)
+        v = next(v for v in report["violations"]
+                 if v["kind"] == "dist-barrier-reorder")
+        assert v["edge"] == EDGE
+        assert sorted(v["processes"]) == [0, 1]
+        assert report["checks"]["barrier-reorder"] == "violation"
+
+    def test_delivery_from_blocked_channel_is_caught(self):
+        """A data frame reached the gate from a channel parked for
+        barrier alignment — the record overtook the checkpoint cut."""
+        sender, receiver = healthy_cohort()
+        receiver = copy.deepcopy(receiver)
+        # Move the second delivery INSIDE the alignment window.
+        for row in receiver["events"]:
+            if row[0] == "frame.deliver" and row[4] == 1:
+                row[1] = 10.5065  # between align.block and align.unblock
+        receiver["events"].sort(key=lambda r: r[1])
+        report = stitch([sender, receiver])
+        assert "dist-barrier-blocked-channel" in _kinds(report)
+        v = next(v for v in report["violations"]
+                 if v["kind"] == "dist-barrier-blocked-channel")
+        assert v["edge"] == EDGE
+
+    def test_cross_process_deadlock_is_reported(self):
+        """Sender parked at zero credit + receiver gate full with no
+        resume = a waits-for cycle across the wire, reported as a
+        diagnosis instead of a hang."""
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        receiver = copy.deepcopy(receiver)
+        sender["events"].append(
+            ["credit.park", 10.0100, EDGE, CONN, 0,
+             {"gen": 0, "floor": 0}])
+        receiver["events"].append(
+            ["gate.full", 10.5110, EDGE, CONN, 1, {}])
+        report = stitch([sender, receiver])
+        assert "dist-deadlock" in _kinds(report)
+        v = next(v for v in report["violations"]
+                 if v["kind"] == "dist-deadlock")
+        assert sorted(v["processes"]) == [0, 1]
+        assert "waits-for cycle" in v["message"]
+
+
+# ---------------------------------------------------------------------------
+# Truncation / missing-side handling: skip, never guess.
+# ---------------------------------------------------------------------------
+class TestTruncationSkips:
+    def test_truncated_ring_skips_prefix_dependent_checks(self):
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        sender["truncated"] = True
+        sender["recorded"] = len(sender["events"]) + 100
+        # Shrink the grant: WOULD be a totals overspend, but the spend
+        # prefix is gone — reporting it would be a phantom.
+        receiver = copy.deepcopy(receiver)
+        for row in receiver["events"]:
+            if row[0] == "credit.grant":
+                row[5] = {"n": 1}
+        report = stitch([sender, receiver])
+        assert report["truncated"] is True
+        assert "dist-credit-overspend" not in _kinds(report)
+        assert report["checks"]["credit-overspend"].startswith("skipped")
+        assert report["checks"]["barrier-reorder"].startswith("skipped")
+
+    def test_per_spend_floor_check_survives_truncation(self):
+        """Each ledger row carries its own invariant (balance vs floor),
+        so a below-floor spend is caught even in a truncated log."""
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        sender["truncated"] = True
+        sender["recorded"] = len(sender["events"]) + 100
+        sender["events"].append(
+            ["credit.spend", 10.0060, EDGE, CONN, 2,
+             {"gen": 0, "balance": -2, "floor": 0}])
+        report = stitch([sender, receiver])
+        assert "dist-credit-overspend" in _kinds(report)
+
+    def test_local_violations_surface_in_report(self):
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        sender["violations"] = [{
+            "kind": "lock-order-inversion", "message": "seeded",
+            "thread": "t"}]
+        report = stitch([sender, receiver])
+        assert report["violations"] == []
+        assert len(report["local_violations"]) == 1
+        assert report["local_violations"][0]["process"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: merge per-process logs, exit non-zero on violations.
+# ---------------------------------------------------------------------------
+class TestCli:
+    def _write(self, tmp_path, docs):
+        paths = []
+        for i, doc in enumerate(docs):
+            p = tmp_path / f"hb.proc{i}.json"
+            p.write_text(json.dumps(doc))
+            paths.append(str(p))
+        return paths
+
+    def test_clean_cohort_exits_zero(self, tmp_path, capsys):
+        paths = self._write(tmp_path, healthy_cohort())
+        out = str(tmp_path / "report.json")
+        rc = stitch_mod.main([*paths, "--cohort", "--out", out])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "conformant" in printed
+        report = load_report(out)
+        assert report["violations"] == []
+
+    def test_violating_cohort_exits_nonzero_and_names_the_edge(
+            self, tmp_path, capsys):
+        sender, receiver = healthy_cohort()
+        receiver = copy.deepcopy(receiver)
+        for row in receiver["events"]:
+            if row[0] == "epoch.handshake":
+                row[5] = {"role": "recv", "epoch": 0, "server_epoch": 1,
+                          "stale": True}
+        paths = self._write(tmp_path, [sender, receiver])
+        rc = stitch_mod.main([*paths, "--cohort"])
+        assert rc == 1
+        printed = capsys.readouterr().out
+        assert "dist-epoch-fence" in printed
+        assert EDGE in printed
+
+    def test_local_violation_alone_fails_the_run(self, tmp_path):
+        sender, receiver = healthy_cohort()
+        sender = copy.deepcopy(sender)
+        sender["violations"] = [{
+            "kind": "stall", "message": "seeded", "thread": "t"}]
+        paths = self._write(tmp_path, [sender, receiver])
+        assert stitch_mod.main([*paths, "--cohort"]) == 1
+
+    def test_unreadable_log_exits_two(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        assert stitch_mod.main([str(bad)]) == 2
+
+    def test_doctor_ranks_sanitizer_violations_first(self, tmp_path):
+        from flink_tensorflow_tpu.tracing.doctor import diagnose
+
+        sender, receiver = healthy_cohort()
+        receiver = copy.deepcopy(receiver)
+        for row in receiver["events"]:
+            if row[0] == "epoch.handshake":
+                row[5] = {"role": "recv", "epoch": 0, "server_epoch": 1,
+                          "stale": True}
+        report = stitch([sender, receiver])
+        # A snapshot with a breached rule: the sanitizer evidence must
+        # still outrank it.
+        snapshot = {"op.0": {"in_backpressure_s": 100.0,
+                             "backpressure_s": 50.0, "queue_depth": 10.0}}
+        doc = diagnose(snapshot, sanitizer_report=report)
+        assert doc["findings"][0].startswith("sanitizer: dist-epoch-fence")
+        assert any(EDGE in line for line in doc["sanitizer"])
+
+    def test_doctor_cli_loads_report(self, tmp_path, capsys):
+        from flink_tensorflow_tpu.tracing import doctor
+
+        report = stitch(list(healthy_cohort()))
+        p = tmp_path / "report.json"
+        p.write_text(json.dumps(report))
+        rc = doctor.main(["--sanitizer", str(p), "--report-only"])
+        assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# Integration: a sanitized job dumps its log at join; flight recorder
+# and hb log cross-reference each other (satellite 3).
+# ---------------------------------------------------------------------------
+class TestJobHbDump:
+    def test_sanitized_job_dumps_hb_log_with_flight_cross_ref(self):
+        with tempfile.TemporaryDirectory() as d:
+            hb_path = os.path.join(d, "job.hb.json")
+            flight_path = os.path.join(d, "job.flight.json")
+            env = StreamExecutionEnvironment(parallelism=2)
+            env.configure(sanitize=True, sanitize_log_path=hb_path,
+                          flight_path=flight_path)
+            env.enable_checkpointing(d, every_n_records=8)
+            out = (env.from_collection(list(range(32)), parallelism=1)
+                   .map(lambda v: v + 1, name="inc", parallelism=1)
+                   .rebalance()
+                   .map(lambda v: v * 2, name="dbl", parallelism=2)
+                   .sink_to_list())
+            env.execute("hb-dump-job", timeout=120)
+            assert sorted(out) == sorted((v + 1) * 2 for v in range(32))
+            doc = load_hb_log(hb_path)
+            assert doc["reason"] == "shutdown"
+            assert doc["violations"] == []
+            # Barrier injections are on the record.
+            kinds = {row[0] for row in doc["events"]}
+            assert "barrier.inject" in kinds
+            # Satellite 3: the hb dump points at the flight dump path.
+            assert doc["extra"]["flight_dump"] == flight_path
+            # A single-process log stitches clean.
+            report = stitch([doc])
+            assert report["violations"] == []
+            # Cohort gauges ride the metric plane.
+            snap = env.metric_registry.report()
+            assert snap.get("sanitizer.cohort.hb_recorded", 0) > 0
+            assert snap.get("sanitizer.cohort.violations") == 0
+
+    def test_unsanitized_job_writes_no_log(self):
+        with tempfile.TemporaryDirectory() as d:
+            hb_path = os.path.join(d, "job.hb.json")
+            env = StreamExecutionEnvironment(parallelism=1)
+            env.configure(sanitize_log_path=hb_path)
+            env.from_collection([1, 2, 3]).sink_to_list()
+            env.execute("no-sanitizer", timeout=60)
+            assert not os.path.exists(hb_path)
