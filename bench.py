@@ -2570,6 +2570,12 @@ def bench_shuffle(args) -> dict:
 BENCH_R09_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_r09.json")
 
+#: shardcheck predicted-vs-measured validation lands here (the r13
+#: booking): the static analyzer's per-step h2d / collective predictions
+#: diffed against the traced serving run's runtime counters.
+BENCH_R13_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json")
+
 
 def bench_serving(args) -> dict:
     """Open-loop keyed session arrivals through BOTH serving arms at >=2
@@ -2586,6 +2592,10 @@ def bench_serving(args) -> dict:
     import jax
 
     from flink_tensorflow_tpu import StreamExecutionEnvironment, serving
+    from flink_tensorflow_tpu.analysis.shardcheck import (
+        COLLECTIVE_PRIMS as _COLLECTIVE_PRIMS,
+    )
+    from flink_tensorflow_tpu.analysis.shardcheck import report_for_env
     from flink_tensorflow_tpu.models import get_model_def
     from flink_tensorflow_tpu.sources import PacedSplitSource
     from flink_tensorflow_tpu.tracing.attribution import attribution
@@ -2666,8 +2676,11 @@ def bench_serving(args) -> dict:
         handle = env.execute_async(f"bench-serving-{arm}")
         handle.wait(timeout=3600)
         attr = None
+        trace_rows = None
         if trace and handle.executor.tracer is not None:
-            full = attribution(handle.executor.tracer.events())
+            tracer = handle.executor.tracer
+            tracer_events = tracer.events()
+            full = attribution(tracer_events)
             attr = {
                 op: {stage: {k: row[k] for k in
                              ("count", "p50_ms", "p95_ms", "total_ms")
@@ -2675,6 +2688,32 @@ def bench_serving(args) -> dict:
                      for stage, row in stages.items()}
                 for op, stages in full.items()
             }
+            if arm == "continuous":
+                # Raw-span decomposition of the runner's step_h2d_bytes
+                # counter, for the shardcheck predicted-vs-measured diff:
+                # each decode.prefill span carries its (batch, prompt)
+                # bucket, so its h2d is bucket[0]*bucket[1]*4 (tokens)
+                # + bucket[0]*8 (lengths + slots) — subtracting the sum
+                # from the counter leaves the decode-step-only bytes the
+                # analyzer predicts.  Valid only when the ring dropped
+                # nothing (trace_dropped guards the comparison).
+                prefill_h2d = 0
+                decode_spans = 0
+                coll_spans = 0
+                for _, name, _, _, _, ev_args in tracer_events:
+                    if name == "decode.prefill" and ev_args:
+                        b, t = ev_args["bucket"]
+                        prefill_h2d += b * t * 4 + b * 8
+                    elif name == "decode.step":
+                        decode_spans += 1
+                    elif name.rstrip("0123456789") in _COLLECTIVE_PRIMS:
+                        coll_spans += 1
+                trace_rows = {
+                    "trace_prefill_h2d_bytes": prefill_h2d,
+                    "trace_decode_step_spans": decode_spans,
+                    "trace_collective_spans": coll_spans,
+                    "trace_dropped": tracer.dropped(),
+                }
         tok_lat, ttft = [], []
         first_sched, last_emit = None, None
         for t_emit, ev in events:
@@ -2715,6 +2754,8 @@ def bench_serving(args) -> dict:
                 "cache_h2d_blocks": ctr("cache_h2d_blocks"),
                 "cache_d2h_blocks": ctr("cache_d2h_blocks"),
             })
+            if trace_rows is not None:
+                out.update(trace_rows)
         return out, attr
 
     points = []
@@ -2741,6 +2782,49 @@ def bench_serving(args) -> dict:
                 else None),
         })
 
+    # --- shardcheck predicted-vs-measured (PR 16) -----------------------
+    # The SAME continuous plan, captured but never executed: the static
+    # analyzer's abstract trace predicts the steady-state per-decode-step
+    # h2d bytes and the per-step collective count, and the traced run
+    # above measured both.  The diff is the analyzer's honesty check —
+    # and the analysis wall time is what a pre-submit gate would pay.
+    t_an = time.perf_counter()
+    plan_env = StreamExecutionEnvironment(parallelism=1)
+    serving.continuous_batching(
+        plan_env.from_source(
+            PacedSplitSource(requests, rates[-1], num_splits=1),
+            name="sessions", parallelism=1,
+        ).key_by(lambda r: r.session_id),
+        model, config=cfg,
+    ).sink_to_list()
+    sc_report = report_for_env(plan_env, pipeline="bench:serving/continuous")
+    analysis_wall_s = time.perf_counter() - t_an
+    sc_op = next((op for op in sc_report["operators"]
+                  if op["kind"] == "serving"), None)
+    cont_top = points[-1]["continuous"]
+    predicted_h2d = sc_op["predicted_step_h2d_bytes"] if sc_op else None
+    predicted_coll = sum(sc_op["collectives"].values()) if sc_op else None
+    measured_h2d = None
+    steps = cont_top.get("serving_steps") or 0
+    prefill_h2d = cont_top.get("trace_prefill_h2d_bytes")
+    if steps and prefill_h2d is not None and not cont_top.get("trace_dropped"):
+        # Counter minus the trace-derived prefill share, per decode step.
+        measured_h2d = (cont_top["step_h2d_bytes"] - prefill_h2d) / steps
+    shardcheck_cmp = {
+        "predicted_step_h2d_bytes": predicted_h2d,
+        "measured_step_h2d_bytes": (round(measured_h2d, 2)
+                                    if measured_h2d is not None else None),
+        "h2d_delta_bytes": (round(measured_h2d - predicted_h2d, 2)
+                            if measured_h2d is not None
+                            and predicted_h2d is not None else None),
+        "predicted_collectives_per_step": predicted_coll,
+        "measured_collective_spans": cont_top.get("trace_collective_spans"),
+        "serving_steps": steps,
+        "trace_prefill_h2d_bytes": prefill_h2d,
+        "step_h2d_bytes_counter": cont_top.get("step_h2d_bytes"),
+        "analysis_wall_ms": round(analysis_wall_s * 1000.0, 1),
+        "analyzer_errors": sc_report["errors"],
+    }
     detail = {
         "workload": "serving",
         "model": {"architecture": "char_transformer",
@@ -2752,7 +2836,22 @@ def bench_serving(args) -> dict:
                    "padding_buckets": cfg.padding_buckets},
         "points": points,
         "trace_attribution": attr_tables,
+        "shardcheck": shardcheck_cmp,
     }
+    # Book the predicted-vs-measured evidence on its own (the r13
+    # booking) — same write-then-rename contract as every BENCH file.
+    try:
+        tmp = BENCH_R13_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe({
+                "workload": "shardcheck_predicted_vs_measured",
+                "comparison": shardcheck_cmp,
+                "static_report": sc_report,
+            }), f, allow_nan=False, indent=1)
+        os.replace(tmp, BENCH_R13_PATH)
+        shardcheck_cmp["full_detail"] = "BENCH_r13.json"
+    except OSError:
+        shardcheck_cmp["full_detail"] = None
     # Book the round's serving evidence (write-then-rename, same
     # contract as BENCH_full.json: never truncate a good prior file).
     try:
@@ -2783,6 +2882,13 @@ def bench_serving(args) -> dict:
         "counters": {k: top["continuous"].get(k) for k in
                      ("admitted", "evicted", "preempted", "rejected",
                       "serving_steps")},
+        "shardcheck": {k: shardcheck_cmp.get(k) for k in
+                       ("predicted_step_h2d_bytes",
+                        "measured_step_h2d_bytes", "h2d_delta_bytes",
+                        "predicted_collectives_per_step",
+                        "measured_collective_spans",
+                        "analysis_wall_ms", "analyzer_errors",
+                        "full_detail")},
         "continuous_dominates_all_points": all(
             p["continuous_dominates_tokens_per_s"]
             and p["continuous_dominates_ttft"] for p in points),
@@ -3704,6 +3810,20 @@ def _scoreboard(outputs: list) -> dict:
         others[name] = [o.get("value"), o.get("unit")]
     if others:
         sb["workloads"] = others
+    # shardcheck predicted-vs-measured digest (PR 16): the static
+    # analyzer's per-step h2d prediction against the traced serving
+    # run, and what the analysis pass itself cost in wall time.
+    sc = next((o.get("shardcheck") for o in outputs
+               if o.get("shardcheck")), None)
+    if sc:
+        sb["shardcheck"] = {
+            "pred_h2d_B": sc.get("predicted_step_h2d_bytes"),
+            "meas_h2d_B": sc.get("measured_step_h2d_bytes"),
+            "delta_B": sc.get("h2d_delta_bytes"),
+            "collectives": [sc.get("predicted_collectives_per_step"),
+                            sc.get("measured_collective_spans")],
+            "analysis_ms": sc.get("analysis_wall_ms"),
+        }
     return sb
 
 
@@ -3714,7 +3834,7 @@ def _fit_scoreboard(sb: dict, limit: int = SCOREBOARD_MAX_BYTES) -> dict:
     add.  The headline metric/value/latency keys are never dropped."""
     droppable = [
         "trace_overhead", "fetch_elided_batches", "wire_bytes_saved",
-        "workloads", "mfu_sweep_batch_pct",
+        "shardcheck", "workloads", "mfu_sweep_batch_pct",
         "wire_ceiling_rps_range", "resnet_train", "bottleneck",
         "open_loop", "wire_mb_s_bracket",
     ]

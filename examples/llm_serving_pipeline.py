@@ -72,6 +72,15 @@ def main(argv=None):
     ]
 
     env = StreamExecutionEnvironment(parallelism=args.parallelism)
+    # Declared serving layout: an ABSTRACT v5e-8 mesh (data=4 x tp=2) +
+    # the per-chip HBM ceiling.  Nothing at execution time touches these
+    # on a CPU box — they exist so `flink-tpu-shardcheck` (and the
+    # analyzer's shardcheck-* rules) can audit partitioning, donation,
+    # and the static HBM budget of this plan without any TPU attached.
+    from flink_tensorflow_tpu.parallel import abstract_mesh
+
+    env.set_mesh(abstract_mesh({"data": 4, "tp": 2}))
+    env.set_hbm_budget(16 * 1024**3)  # v5e: 16 GiB per chip
     events = (
         serving.continuous_batching(
             # Open-loop arrivals: sessions show up on a Poisson schedule

@@ -43,6 +43,14 @@ from flink_tensorflow_tpu.analysis.sanitizer import (
     scan_operator,
 )
 from flink_tensorflow_tpu.analysis.schema_prop import SchemaFlow, propagate
+from flink_tensorflow_tpu.analysis.shardcheck import (
+    OpAudit,
+    PlanAudit,
+    SpecLayout,
+    audit_of,
+    audit_plan,
+    report_for_env,
+)
 
 __all__ = [
     "RULES",
@@ -50,12 +58,17 @@ __all__ = [
     "ChainPlan",
     "Diagnostic",
     "LintRule",
+    "OpAudit",
+    "PlanAudit",
     "PlanCaptured",
     "PlanValidationError",
     "PurityFinding",
     "SchemaFlow",
     "Severity",
+    "SpecLayout",
     "analyze",
+    "audit_of",
+    "audit_plan",
     "capture_pipeline_file",
     "capture_plan",
     "capturing_execution",
@@ -65,6 +78,7 @@ __all__ = [
     "format_diagnostics",
     "has_errors",
     "propagate",
+    "report_for_env",
     "rule",
     "scan_callable",
     "scan_code",

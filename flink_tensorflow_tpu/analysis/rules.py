@@ -956,3 +956,15 @@ def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
                 "compile a fresh batch size; set a BucketPolicy",
                 node=t.name,
             )
+
+
+# ---------------------------------------------------------------------------
+# shardcheck family (analysis/shardcheck.py): the SPMD layout / donation /
+# HBM-budget / compile-signature verdicts register themselves here so
+# analyze(), validate_plan(), and every CLI carry them.  Imported at the
+# bottom because shardcheck needs `rule` (defined above) at registration.
+# ---------------------------------------------------------------------------
+
+from flink_tensorflow_tpu.analysis import shardcheck as _shardcheck  # noqa: E402
+
+_shardcheck._register_rules()

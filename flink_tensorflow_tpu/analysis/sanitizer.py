@@ -174,13 +174,46 @@ def _classify_chain(
     return None
 
 
+#: Interprocedural depth: helpers called DIRECTLY from a scanned user
+#: function are scanned too (one level); their callees are not.  One
+#: level catches the ubiquitous "map fn delegates to a module helper"
+#: split without turning the scanner into a whole-program analysis.
+_MAX_CALL_DEPTH = 1
+
+
+def _helper_fn(
+    chain: typing.Sequence[str], globals_ns: typing.Optional[dict]
+) -> typing.Optional[types.FunctionType]:
+    """The USER-DEFINED function a global attribute chain names, if any —
+    the interprocedural edge.  Stdlib/framework callees resolve but live
+    outside user code and are cut off here; unresolvable chains (locals,
+    arguments) never form an edge."""
+    resolved = _resolve_chain(chain, globals_ns)
+    if resolved is _MISSING:
+        return None
+    fn = _unwrap(resolved)
+    if fn is None or not _is_user_code(fn.__code__):
+        return None
+    return fn
+
+
 def scan_code(
     code: types.CodeType,
     globals_ns: typing.Optional[dict] = None,
     where: typing.Optional[str] = None,
+    *,
+    _depth: int = 0,
+    _seen: typing.Optional[typing.Set[int]] = None,
 ) -> typing.List[PurityFinding]:
-    """Purity findings for one code object (nested code included)."""
+    """Purity findings for one code object (nested code included), plus
+    — one direct-call level deep — every user-defined helper it names
+    (scanned with the same matrix, attributed ``outer -> helper``).
+    Recursion is cut by a seen-set over code objects, stdlib/framework
+    callees by the user-code filter."""
     findings: typing.List[PurityFinding] = []
+    seen = _seen if _seen is not None else set()
+    seen.add(id(code))
+    helpers: typing.List[types.FunctionType] = []
     top = where or getattr(code, "co_qualname", code.co_name)
     for co in _iter_code_objects(code):
         qual = top if co is code else f"{top}.<{co.co_name}>"
@@ -192,13 +225,13 @@ def scan_code(
                 line = instr.starts_line
             op = instr.opname
             if op in ("LOAD_GLOBAL", "LOAD_NAME"):
-                _flush(chain, chain_line, globals_ns, qual, findings)
+                _flush(chain, chain_line, globals_ns, qual, findings, helpers)
                 chain = [instr.argval]
                 chain_line = line
             elif op in ("LOAD_ATTR", "LOAD_METHOD") and chain:
                 chain.append(instr.argval)
             else:
-                _flush(chain, chain_line, globals_ns, qual, findings)
+                _flush(chain, chain_line, globals_ns, qual, findings, helpers)
                 chain = []
                 if op in ("STORE_GLOBAL", "DELETE_GLOBAL"):
                     findings.append(PurityFinding(
@@ -206,11 +239,20 @@ def scan_code(
                         symbol=f"global {instr.argval}",
                         where=qual, line=line,
                     ))
-        _flush(chain, chain_line, globals_ns, qual, findings)
+        _flush(chain, chain_line, globals_ns, qual, findings, helpers)
+    if _depth < _MAX_CALL_DEPTH:
+        for helper in helpers:
+            if id(helper.__code__) in seen:
+                continue  # recursion / already-scanned helper
+            findings.extend(scan_code(
+                helper.__code__, helper.__globals__,
+                where=f"{top} -> {helper.__qualname__}",
+                _depth=_depth + 1, _seen=seen,
+            ))
     return findings
 
 
-def _flush(chain, chain_line, globals_ns, qual, findings) -> None:
+def _flush(chain, chain_line, globals_ns, qual, findings, helpers) -> None:
     if not chain:
         return
     hit = _classify_chain(chain, globals_ns)
@@ -218,6 +260,10 @@ def _flush(chain, chain_line, globals_ns, qual, findings) -> None:
         kind, symbol = hit
         findings.append(PurityFinding(kind=kind, symbol=symbol,
                                       where=qual, line=chain_line))
+        return
+    helper = _helper_fn(chain, globals_ns)
+    if helper is not None:
+        helpers.append(helper)
 
 
 def _unwrap(member: typing.Any) -> typing.Optional[types.FunctionType]:

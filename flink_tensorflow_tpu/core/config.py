@@ -216,8 +216,19 @@ class JobConfig:
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     #: Assigns a jax device per (task_name, subtask_index) — operator DP.
     device_provider: typing.Optional[typing.Callable[[str, int], typing.Any]] = None
-    #: Shared jax.sharding.Mesh for gang operators (DP/TP training).
+    #: Shared jax.sharding.Mesh for gang operators (DP/TP training), or a
+    #: jax.sharding.AbstractMesh (parallel.mesh.abstract_mesh) declaring a
+    #: target layout for PLAN-TIME analysis only: a CPU-only dev box can
+    #: declare a v5e-8 mesh and run analysis/shardcheck.py against it,
+    #: but a job whose operators need devices cannot open on one.
     mesh: typing.Optional[typing.Any] = None
+    #: Per-device HBM ceiling (bytes) for the static memory budget
+    #: (analysis/shardcheck.py): params + optimizer state + KV pool +
+    #: peak activation liveness, summed per device under the declared
+    #: mesh, must fit or the plan fails validation with ERROR
+    #: provenance.  None disables the budget gate.  The admission gate
+    #: of the paged-KV-economy arc: v5e = 16 GiB/chip, v5p = 95 GiB.
+    hbm_budget_bytes: typing.Optional[int] = None
     #: User-level parameters readable from RuntimeContext (the reference's
     #: GlobalJobParameters role).  Not interpreted by the framework.
     user_params: typing.Mapping[str, typing.Any] = dataclasses.field(default_factory=dict)
@@ -282,8 +293,20 @@ class JobConfig:
             )
         if self.device_provider is not None and not callable(self.device_provider):
             raise ValueError("device_provider must be callable (task, idx) -> device")
-        if self.mesh is not None and not hasattr(self.mesh, "devices"):
-            raise ValueError(f"mesh must be a jax.sharding.Mesh, got {type(self.mesh).__name__}")
+        if self.mesh is not None:
+            # NOTE: hasattr(AbstractMesh, "devices") RAISES (jax makes the
+            # unimplemented property loud), so probe shape/axis_names —
+            # present on both Mesh and AbstractMesh — instead.
+            if not (hasattr(self.mesh, "shape")
+                    and hasattr(self.mesh, "axis_names")):
+                raise ValueError(
+                    "mesh must be a jax.sharding.Mesh (or AbstractMesh for "
+                    f"plan-time analysis), got {type(self.mesh).__name__}"
+                )
+        if self.hbm_budget_bytes is not None and self.hbm_budget_bytes < 1:
+            raise ValueError(
+                f"hbm_budget_bytes must be >= 1, got {self.hbm_budget_bytes}"
+            )
         if self.distributed is not None:
             self.distributed.validate()
             if self.checkpoint.interval_s is not None:

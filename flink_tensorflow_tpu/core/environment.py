@@ -197,8 +197,21 @@ class StreamExecutionEnvironment:
         return self.configure(device_provider=provider)
 
     def set_mesh(self, mesh) -> "StreamExecutionEnvironment":
-        """Share a jax.sharding.Mesh with gang operators (DP/TP training)."""
+        """Share a jax.sharding.Mesh with gang operators (DP/TP training).
+
+        Also accepts a ``jax.sharding.AbstractMesh``
+        (``parallel.mesh.abstract_mesh``): a shape-only mesh declaration
+        the plan-time sharding analyzer (analysis/shardcheck.py) checks
+        layouts and memory budgets against on boxes with no devices.
+        """
         return self.configure(mesh=mesh)
+
+    def set_hbm_budget(self, hbm_budget_bytes: typing.Optional[int]) -> "StreamExecutionEnvironment":
+        """Declare the per-device HBM ceiling the plan must fit
+        (JobConfig.hbm_budget_bytes): shardcheck's static memory budget
+        — params + optimizer state + KV pool + peak activation liveness
+        per device under the mesh — gates validation against it."""
+        return self.configure(hbm_budget_bytes=hbm_budget_bytes)
 
     # -- legacy attribute surface (delegates to the typed config) ---------
     @property

@@ -83,9 +83,22 @@ class _ModelFunctionBase(fn.RichFunction):
         stamp_stages: bool = False,
         device_resident: typing.Optional[bool] = None,
         wire_dtype: typing.Optional[str] = None,
+        sharding_axes: typing.Optional[typing.Sequence[str]] = None,
+        output_sharding_axes: typing.Optional[typing.Sequence[str]] = None,
     ):
         self._source = model
         self._method_name = method
+        #: Declared SPMD layouts for the plan analyzers (chaining's
+        #: sharding-conflict rule reads ``sharding_axes``; shardcheck's
+        #: reshard audit compares upstream ``output_sharding_axes``
+        #: against the consumer's input axes).  ``output_sharding_axes``
+        #: defaults to the input axes — a jit unit that changes its batch
+        #: layout (e.g. gathers model-parallel shards) declares it here.
+        if sharding_axes is not None:
+            self.sharding_axes = tuple(sharding_axes)
+        self.output_sharding_axes = (
+            tuple(output_sharding_axes) if output_sharding_axes is not None
+            else (tuple(sharding_axes) if sharding_axes is not None else None))
         self._policy = policy
         self._warmup = tuple(warmup_batches)
         self._warmup_length_bucket = warmup_length_bucket

@@ -14,11 +14,15 @@ from flink_tensorflow_tpu.parallel.dp import (
 from flink_tensorflow_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
+    FSDP_AXIS,
     MODEL_AXIS,
     PIPE_AXIS,
     SEQ_AXIS,
+    TP_AXIS,
     MeshSpec,
+    abstract_mesh,
     batch_sharding,
+    is_abstract_mesh,
     make_mesh,
     named_sharding,
     replicate,
@@ -47,16 +51,20 @@ from flink_tensorflow_tpu.parallel.ulysses import (
 __all__ = [
     "DATA_AXIS",
     "EXPERT_AXIS",
+    "FSDP_AXIS",
     "MODEL_AXIS",
     "MeshSpec",
     "PIPE_AXIS",
     "SEQ_AXIS",
+    "TP_AXIS",
     "CohortFailed",
     "CohortOutcome",
     "CohortSupervisor",
+    "abstract_mesh",
     "batch_sharding",
     "full_attention",
     "init_train_state",
+    "is_abstract_mesh",
     "latest_common_checkpoint",
     "make_dp_train_step",
     "make_mesh",

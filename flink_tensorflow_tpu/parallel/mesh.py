@@ -28,15 +28,21 @@ import typing
 import weakref
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
+TP_AXIS = "tp"
 
 #: Canonical axis order: DCN-adjacent parallelism first (pipe/data tolerate
-#: lower bandwidth), ICI-hungry axes (model/seq) innermost where the device
-#: mesh puts physically-adjacent chips (scaling-book mesh recipe).
-AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+#: lower bandwidth), ICI-hungry axes (model/seq/tp) innermost where the
+#: device mesh puts physically-adjacent chips (scaling-book mesh recipe).
+#: ``fsdp`` (param shards gathered per layer) and ``tp`` (within-layer
+#: tensor parallel, the SpecLayout convention of the sharded-serving arc)
+#: join the order for the zoo-scale layouts shardcheck analyzes.
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQ_AXIS,
+              MODEL_AXIS, TP_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +104,29 @@ class MeshSpec:
 def make_mesh(axes: typing.Mapping[str, int], devices=None):
     """``make_mesh({"data": 8})`` -> Mesh; the one-liner for jobs."""
     return MeshSpec(axes).build(devices)
+
+
+def abstract_mesh(axes: typing.Mapping[str, int]):
+    """A ``jax.sharding.AbstractMesh`` over the declared axes — a mesh
+    with SHAPE but no devices, so a CPU-only dev box can declare (and
+    statically analyze, via analysis/shardcheck.py) a v5e-8 layout it
+    cannot materialize.  ``env.set_mesh(abstract_mesh({"data": 4,
+    "model": 2}))`` is the plan-analysis posture; executing a job that
+    actually needs devices on an abstract mesh fails at open().
+    """
+    spec = MeshSpec(axes)  # validates names/sizes against AXIS_ORDER
+    from jax.sharding import AbstractMesh
+
+    return AbstractMesh(tuple((a, spec.axes[a]) for a in spec.axis_names))
+
+
+def is_abstract_mesh(mesh) -> bool:
+    """True for AbstractMesh declarations (shape-only, no devices)."""
+    try:
+        from jax.sharding import AbstractMesh
+    except ImportError:  # pragma: no cover - ancient jax
+        return False
+    return isinstance(mesh, AbstractMesh)
 
 
 # -- shardings --------------------------------------------------------------

@@ -88,6 +88,22 @@ class ServingConfig:
                 return b
         return self.max_active_seqs
 
+    def compile_signatures(
+        self,
+    ) -> typing.Optional[typing.Tuple[typing.Tuple[str, int, int], ...]]:
+        """Every distinct jit signature this config can present, as
+        ``(kind, batch, length)`` tuples — the prefill admit x prompt
+        bucket grid plus the single padded decode step — or ``None``
+        when ``padding_buckets`` is off and the set is unbounded (the
+        recompile-churn footgun, statically visible to shardcheck)."""
+        if not self.padding_buckets:
+            return None
+        sigs = [("prefill", b, t)
+                for b in self.resolved_admit_buckets()
+                for t in self.resolved_prompt_buckets()]
+        sigs.append(("decode", self.max_active_seqs, 1))
+        return tuple(sigs)
+
 
 @dataclasses.dataclass
 class SchedulerCounters:

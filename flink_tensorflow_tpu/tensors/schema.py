@@ -121,13 +121,26 @@ class RecordSchema:
             for name, spec in self.fields.items()
         }
 
-    def batched_struct(self, batch: int):
+    def batched_struct(self, batch: int,
+                       length_bucket: typing.Optional[int] = None):
         """``jax.ShapeDtypeStruct`` pytree for a ``[B, ...]`` batch — feeds
-        ``jax.eval_shape``/AOT compilation without materializing data."""
+        ``jax.eval_shape``/AOT compilation without materializing data.
+
+        Dynamic dims stay ``None`` by default (callers that only compare
+        ranks/dtypes want them visible); pass ``length_bucket`` to pin
+        them — the resolve_dynamic rule — so the struct is fully static
+        and traceable (``jax.make_jaxpr``, shardcheck's abstract pass).
+        """
         import jax
 
+        if length_bucket is None:
+            return {
+                name: jax.ShapeDtypeStruct(spec.with_batch(batch), spec.dtype)
+                for name, spec in self.fields.items()
+            }
+        shapes = self.resolve_dynamic(length_bucket)
         return {
-            name: jax.ShapeDtypeStruct(spec.with_batch(batch), spec.dtype)
+            name: jax.ShapeDtypeStruct((batch, *shapes[name]), spec.dtype)
             for name, spec in self.fields.items()
         }
 

@@ -241,6 +241,25 @@ def sanitizer_findings(report: typing.Optional[dict]
     return out
 
 
+def shardcheck_findings(report: typing.Optional[dict]
+                        ) -> typing.List[str]:
+    """Static shardcheck verdicts (``flink-tpu-shardcheck --out``)
+    folded into doctor findings.  ERROR findings are plan-level proof
+    (an over-budget HBM plan, a ragged partition) and rank right after
+    the sanitizer's protocol violations; WARNs ride along as advisory
+    layout context for the statistical signals."""
+    if not report:
+        return []
+    out: typing.List[str] = []
+    for f in report.get("findings", ()):
+        if f.get("severity") == "INFO":
+            continue
+        where = f.get("edge") or f.get("node") or "plan"
+        out.append(f"shardcheck {f.get('severity', '?')} "
+                   f"[{f.get('rule', '?')}] {where}: {f.get('message', '')}")
+    return out
+
+
 def diagnose(
     snapshot: typing.Optional[Snapshot] = None,
     *,
@@ -248,6 +267,7 @@ def diagnose(
     flight_docs: typing.Sequence[dict] = (),
     decision: typing.Optional[dict] = None,
     sanitizer_report: typing.Optional[dict] = None,
+    shardcheck_report: typing.Optional[dict] = None,
     channel_capacity: int = 1024,
     top: int = 3,
 ) -> typing.Dict[str, typing.Any]:
@@ -266,8 +286,9 @@ def diagnose(
     stages = stage_dominance(events)
     actions = supervisor_actions(flight_docs, decision)
     san_findings = sanitizer_findings(sanitizer_report)
+    shard_findings = shardcheck_findings(shardcheck_report)
 
-    findings: typing.List[str] = list(san_findings)
+    findings: typing.List[str] = list(san_findings) + list(shard_findings)
     named: typing.Set[str] = set()
     for rank, b in enumerate(bottlenecks[:top], start=1):
         op = b["operator"]
@@ -325,6 +346,7 @@ def diagnose(
         "stages": stages,
         "actions": actions,
         "sanitizer": san_findings,
+        "shardcheck": shard_findings,
     }
 
 
@@ -374,6 +396,11 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                              "(flink-tpu-sanitize --out): proven protocol "
                              "violations rank above every statistical "
                              "signal")
+    parser.add_argument("--shardcheck", default=None, metavar="REPORT.json",
+                        help="static shardcheck report "
+                             "(flink-tpu-shardcheck --out): plan-level "
+                             "layout/donation/HBM verdicts fold in after "
+                             "protocol violations")
     parser.add_argument("--channel-capacity", type=int, default=1024,
                         help="channel capacity the queue-depth thresholds "
                              "scale against (default 1024)")
@@ -389,6 +416,7 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     events: typing.List[tuple] = []
     flight_docs: typing.List[dict] = []
     sanitizer_report: typing.Optional[dict] = None
+    shardcheck_report: typing.Optional[dict] = None
     loaded = 0
     try:
         if args.snapshot:
@@ -419,6 +447,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
 
             sanitizer_report = load_report(args.sanitizer)
             loaded += 1
+        if args.shardcheck:
+            with open(args.shardcheck) as f:
+                shardcheck_report = json.load(f)
+            if not isinstance(shardcheck_report, dict):
+                raise ValueError(f"{args.shardcheck}: not a shardcheck "
+                                 "report")
+            loaded += 1
     except (OSError, ValueError) as ex:
         print(f"flink-tpu-doctor: unreadable evidence: {ex}",
               file=sys.stderr)
@@ -435,12 +470,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
         loaded += 1
     if not loaded:
         parser.error("provide at least one of --snapshot / --flight / "
-                     "--trace / --decision / --sanitizer")
+                     "--trace / --decision / --sanitizer / --shardcheck")
     events.sort(key=lambda ev: ev[3])
 
     report = diagnose(
         snapshot, events=events, flight_docs=flight_docs,
         decision=decision, sanitizer_report=sanitizer_report,
+        shardcheck_report=shardcheck_report,
         channel_capacity=args.channel_capacity,
         top=args.top,
     )

@@ -89,6 +89,32 @@ def test_examples_have_zero_purity_lint_errors(pipeline):
     assert errors == [], format_diagnostics(diags)
 
 
+@pytest.mark.parametrize("pipeline", EXAMPLES + [
+    "examples/split_source_pipeline.py",
+    "examples/llm_serving_pipeline.py",
+])
+def test_examples_have_zero_shardcheck_errors(pipeline):
+    """Tier-1 shardcheck gate (PR 16): no example plan may carry an SPMD
+    layout, partition, or HBM-budget ERROR — indivisible shards, resident-
+    chain resharding, and over-budget footprints are all failures a TPU
+    job only discovers after it started.  The serving example declares an
+    abstract v5e-8 mesh + per-chip budget, so its gate exercises the full
+    per-device math; WARNs (donation advice, unbounded ladders) are
+    advisory and allowed."""
+    from flink_tensorflow_tpu.analysis import (
+        Severity,
+        analyze,
+        capture_pipeline_file,
+        format_diagnostics,
+    )
+
+    env = capture_pipeline_file(str(REPO / pipeline))
+    diags = [d for d in analyze(env.graph, config=env.config)
+             if d.rule.startswith("shardcheck")]
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    assert errors == [], format_diagnostics(diags)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("pipeline", EXAMPLES)
 def test_examples_inspect_clean(pipeline):

@@ -443,10 +443,28 @@ class TestCli:
 
 # ---------------------------------------------------------------------------
 # Integration: a sanitized job dumps its log at join; flight recorder
-# and hb log cross-reference each other (satellite 3).
+# and hb log cross-reference each other (satellite 3).  The conformance
+# run is parametrized over shake mode (PR-14 deferral closed): under
+# ``FLINK_TPU_SANITIZE_SHAKE`` the sanitizer's lock wrappers fuzz thread
+# scheduling at every instrumented acquisition, so the SAME stitch
+# checks run against adversarial interleavings — slow CI only.
 # ---------------------------------------------------------------------------
+@pytest.fixture(params=[
+    "plain",
+    pytest.param("shake", marks=pytest.mark.slow),
+])
+def shake_mode(request, monkeypatch):
+    if request.param == "shake":
+        from flink_tensorflow_tpu.core import sanitizer_rt
+
+        monkeypatch.setenv("FLINK_TPU_SANITIZE_SHAKE", "20260806")
+        assert sanitizer_rt.env_shake_seed() == 20260806
+    yield request.param
+
+
 class TestJobHbDump:
-    def test_sanitized_job_dumps_hb_log_with_flight_cross_ref(self):
+    def test_sanitized_job_dumps_hb_log_with_flight_cross_ref(
+            self, shake_mode):
         with tempfile.TemporaryDirectory() as d:
             hb_path = os.path.join(d, "job.hb.json")
             flight_path = os.path.join(d, "job.flight.json")
