@@ -3507,6 +3507,218 @@ def bench_overload(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# workload 13: roofline attribution — the plane replaces the hand math
+# ---------------------------------------------------------------------------
+
+#: Per-jit-unit MFU / bound / drift evidence lands here (the r14
+#: booking): the serving pipeline's live roofline.* gauges plus the
+#: resnet50 train step's plane-computed MFU next to the hand math it
+#: replaces.
+BENCH_R14_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_r14.json")
+
+
+def _roofline_device_spec():
+    """DeviceSpec preset for the local accelerator (longest-prefix kind
+    match, like ``_chip_peak_tflops``).  Off-TPU runs use the
+    deterministic ``cpu-test`` peaks — real (non-degenerate) MFU
+    arithmetic without pretending a CPU is a v5e."""
+    import jax
+
+    from flink_tensorflow_tpu.metrics.roofline import DEVICE_SPECS
+
+    kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    for prefix, name in (("TPU v6", "v6e"), ("TPU v5p", "v5p"),
+                         ("TPU v5", "v5e"), ("TPU v4", "v4")):
+        if kind.startswith(prefix):
+            return DEVICE_SPECS[name]
+    return DEVICE_SPECS["cpu-test"]
+
+
+def bench_roofline(args) -> dict:
+    """Roofline attribution (ISSUE 17): two legs, one instrument.
+
+    **Serving leg** — the continuous-batching pipeline runs with
+    ``JobConfig.roofline`` set: the environment prices its own captured
+    plan (``analysis/costmodel.py``), the DecodeStepRunner joins each
+    measured step against the CostTable, and the ranked per-jit-unit
+    MFU / bound / drift report comes from the LIVE ``roofline.*``
+    gauges — the same snapshot ``flink-tpu-roofline`` consumes.
+
+    **resnet50-train leg** — reruns the MFU probe for its measured step
+    time, then reproduces the scoreboard MFU THROUGH the plane
+    (costmodel FLOPs x measured step time x DeviceSpec peak) and diffs
+    it against ``_train_compute_probe``'s hand math.  Agreement
+    calibrates the instrument; the static/XLA FLOPs ratio is the
+    deterministic half of that check.  Both legs book BENCH_r14.json."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tensorflow_tpu import StreamExecutionEnvironment, serving
+    from flink_tensorflow_tpu.metrics.roofline import (
+        BOUND_NAMES,
+        RooflineConfig,
+        RooflinePlane,
+        roofline_report,
+    )
+    from flink_tensorflow_tpu.models import get_model_def
+
+    spec = _roofline_device_spec()
+
+    # --- serving leg: live gauges from a roofline-on pipeline ----------
+    n = args.records or (12 if args.smoke else 48)
+    capacity = 40
+    mdef = get_model_def("char_transformer", vocab_size=48, embed_dim=32,
+                         num_heads=2, num_layers=2, capacity=capacity)
+    model = mdef.to_model(mdef.init_params(jax.random.PRNGKey(0)))
+    rng = np.random.RandomState(3)
+    requests = [
+        serving.GenerateRequest(
+            session_id=f"s{i}",
+            prompt=rng.randint(1, 48, (int(rng.randint(4, 11)),)),
+            max_new_tokens=int(rng.randint(4, 9)),
+        )
+        for i in range(n)
+    ]
+    cfg = serving.ServingConfig(max_active_seqs=4, token_budget=256,
+                                capacity=capacity)
+    env = _apply_chaining(StreamExecutionEnvironment(parallelism=1), args)
+    env.configure(roofline=RooflineConfig(device=spec))
+    serving.continuous_batching(
+        env.from_collection(requests).key_by(lambda r: r.session_id),
+        model, config=cfg, parallelism=1,
+    ).sink_to_list()
+    env.execute("bench-roofline-serving")
+    snapshot = env.metric_registry.snapshot()
+    serving_rep = roofline_report(snapshot, device=spec)
+    rows = serving_rep["rows"]
+    findings = serving_rep["findings"]
+    flat = env.metric_registry.report()
+    serving_leg = {
+        "sessions": n,
+        "serving_steps": sum(v for k, v in flat.items()
+                             if k.endswith(".serving_steps")),
+        "rows": rows,
+        "findings": findings,
+    }
+
+    # --- resnet50-train leg: the 32.4% figure through the plane --------
+    dev = jax.devices()[0]
+    hand = _train_compute_probe(dev, smoke=args.smoke)
+    b, size = hand["probe_batch"], hand["image_size"]
+    steps_per_sec = hand.get("steps_per_sec")
+    per_step_s = (1.0 / steps_per_sec) if steps_per_sec else None
+
+    import optax
+
+    from flink_tensorflow_tpu.analysis.costmodel import (
+        CostEntry,
+        CostTable,
+        OperatorCost,
+        cost_of_closed,
+    )
+    from flink_tensorflow_tpu.parallel.dp import init_train_state, make_train_step
+
+    if args.smoke:
+        t_mdef = get_model_def("resnet50", num_classes=10, image_size=size,
+                               width=8, stage_sizes=(1, 1), uint8_input=True)
+    else:
+        t_mdef = get_model_def("resnet50", num_classes=1000, image_size=size,
+                               uint8_input=True)
+    opt = optax.sgd(0.1, momentum=0.9)
+    state_struct = jax.eval_shape(
+        lambda: init_train_state(t_mdef, opt, jax.random.key(0)))
+    step = make_train_step(t_mdef, opt)
+    closed = jax.make_jaxpr(step)(state_struct, {
+        "image": jax.ShapeDtypeStruct((b, size, size, 3), jnp.uint8),
+        "label": jax.ShapeDtypeStruct((b,), jnp.int32),
+    })
+    flops_static, hbm_static, _ = cost_of_closed(closed)
+    sig = f"train:b{b}"
+    h2d = b * size * size * 3 + b * 4
+    table = CostTable(ops=[OperatorCost(
+        node="train", kind="train",
+        entries=[CostEntry(unit="train_step", signature=sig,
+                           flops=flops_static, hbm_bytes=hbm_static,
+                           h2d_bytes=h2d)],
+        predicted_signatures=(sig,))])
+    plane = RooflinePlane(RooflineConfig(device=spec, cost_table=table))
+    probe = plane.probe("train")
+    if per_step_s:
+        # First call records the compile event and is excluded from
+        # throughput attribution (the probe's compile-contamination
+        # rule) — feed it, then the measured steady-state steps.
+        for _ in range(17):
+            probe.observe("train_step", per_step_s, signature=sig,
+                          h2d_bytes=h2d)
+    flops_xla = hand.get("flops_per_step")
+    plane_mfu = round(probe.mfu_pct(), 2) if per_step_s else None
+    train_leg = {
+        "workload": "resnet50_train_step",
+        "probe_batch": b,
+        "image_size": size,
+        "steps_per_sec": steps_per_sec,
+        "signature": sig,
+        "flops_per_step_static": flops_static,
+        "flops_per_step_xla": flops_xla,
+        "flops_static_over_xla": (round(flops_static / flops_xla, 4)
+                                  if flops_xla else None),
+        "compile_events": probe.compile_events,
+        "unpredicted_compiles": probe.unpredicted_compiles,
+        "mfu_pct_plane": plane_mfu,
+        "mfu_pct_hand": hand.get("mfu_pct"),
+        "mfu_plane_minus_hand_pct": (
+            round(plane_mfu - hand["mfu_pct"], 2)
+            if plane_mfu is not None and hand.get("mfu_pct") is not None
+            else None),
+        "membw_pct_plane": (round(probe.membw_pct(), 2)
+                            if per_step_s else None),
+        "bound": BOUND_NAMES[probe.bound()],
+    }
+
+    detail = {
+        "workload": "roofline",
+        "device": spec.to_json(),
+        "serving": serving_leg,
+        "resnet50_train": train_leg,
+        "note": (
+            "off-TPU runs declare the synthetic cpu-test peaks, so the "
+            "absolute MFU is not a hardware claim there; the plane-vs-"
+            "hand delta and the static/XLA FLOPs ratio are the "
+            "calibration evidence on every backend"),
+    }
+    try:
+        tmp = BENCH_R14_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(detail), f, allow_nan=False, indent=1)
+        os.replace(tmp, BENCH_R14_PATH)
+        booked = "BENCH_r14.json"
+    except OSError:
+        booked = None
+    top = rows[0] if rows else {}
+    return {
+        "metric": "roofline_serving_top_mfu_pct",
+        "value": top.get("mfu_pct"),
+        "unit": "%",
+        "vs_baseline": None,
+        "device": spec.name,
+        "top_operator": top.get("operator"),
+        "rows": [[r["operator"], r["mfu_pct"], r["bound"],
+                  r["h2d_drift_frac"]] for r in rows[:4]],
+        "serving_drift_findings": len(findings),
+        "train_mfu_pct_plane_vs_hand": [train_leg["mfu_pct_plane"],
+                                        train_leg["mfu_pct_hand"]],
+        "train_flops_static_over_xla": train_leg["flops_static_over_xla"],
+        "full_detail": booked,
+        "baseline_note": (
+            "the hand-math MFU (_train_compute_probe) IS the baseline: "
+            "the plane must reproduce it from the CostTable join x "
+            "DeviceSpec peak — agreement is the instrument's "
+            "calibration, divergence is a roofline finding"),
+    }
+
+
 WORKLOADS = {
     "inception": bench_inception,
     "mnist": bench_mnist,
@@ -3520,6 +3732,7 @@ WORKLOADS = {
     "chaos": bench_chaos,
     "autoscale": bench_autoscale,
     "overload": bench_overload,
+    "roofline": bench_roofline,
 }
 
 #: --workload aliases, resolved before dispatch ("all" never expands
@@ -3528,6 +3741,147 @@ WORKLOADS = {
 #: the last trace file of the workload — the one whose h2d / compute /
 #: d2h / queue spans decompose the open-loop fetch p99.
 WORKLOAD_ALIASES = {"openloop": "inception"}
+
+
+# ---------------------------------------------------------------------------
+# --compare: the regression differ over two bench artifacts
+# ---------------------------------------------------------------------------
+
+#: Units where smaller is better; everything else — rates, counts,
+#: percentages — regresses by going DOWN.
+_LOWER_IS_BETTER_UNITS = frozenset({"ms", "s", "us", "ns", "bytes", "B"})
+
+
+def _metric_direction(metric: str, unit) -> int:
+    """+1 when larger is better, -1 when smaller is better."""
+    if str(unit or "") in _LOWER_IS_BETTER_UNITS:
+        return -1
+    m = str(metric or "")
+    if "latency" in m or m.endswith(("_ms", "_us", "_ns", "_bytes")):
+        return -1
+    return 1
+
+
+def _bench_rows(doc) -> dict:
+    """metric -> row from any bench artifact shape: BENCH_full.json
+    (``{"workloads": [...]}``), a list of workload lines, one workload
+    line, or a scoreboard digest (itself one metric row, whose
+    ``workloads`` sub-dict expands into ``[value, unit]`` rows)."""
+    if isinstance(doc, dict):
+        wl = doc.get("workloads")
+        rows = wl if isinstance(wl, list) else [doc]
+    elif isinstance(doc, list):
+        rows = doc
+    else:
+        rows = []
+    out = {}
+    for r in rows:
+        if not isinstance(r, dict):
+            continue
+        if r.get("metric") is not None and "value" in r:
+            out[str(r["metric"])] = r
+        sub = r.get("workloads")
+        if isinstance(sub, dict):  # scoreboard digest secondary rows
+            for name, pair in sub.items():
+                if isinstance(pair, (list, tuple)) and len(pair) == 2:
+                    out.setdefault(str(name), {
+                        "metric": name, "value": pair[0], "unit": pair[1]})
+    return out
+
+
+def compare_bench_runs(old_doc, new_doc, threshold: float = 0.05) -> dict:
+    """Per-metric delta table between two bench artifacts.  A row
+    REGRESSES when its value moved against the metric's direction
+    (rates/percentages down, latencies/bytes up) by more than
+    ``threshold`` relative to the old value; added/removed metrics and
+    non-numeric values are reported but never fail the diff on their
+    own — ``removed`` rows land in their own list so a guard can choose
+    to fail on vanished coverage."""
+    old_rows, new_rows = _bench_rows(old_doc), _bench_rows(new_doc)
+    rows, regressions, removed = [], [], []
+    for metric in sorted({*old_rows, *new_rows}):
+        o, nw = old_rows.get(metric), new_rows.get(metric)
+        row = {"metric": metric,
+               "old": o.get("value") if o else None,
+               "new": nw.get("value") if nw else None,
+               "unit": (nw or o or {}).get("unit")}
+        if o is None or nw is None:
+            row["verdict"] = "added" if o is None else "removed"
+            if nw is None:
+                removed.append(metric)
+        else:
+            ov, nv = row["old"], row["new"]
+            numeric = all(isinstance(v, (int, float))
+                          and not isinstance(v, bool) for v in (ov, nv))
+            if not numeric or not ov:
+                row["verdict"] = "n/a"
+            else:
+                delta = (nv - ov) / abs(ov)
+                row["delta_pct"] = round(100.0 * delta, 2)
+                signed = _metric_direction(metric, row["unit"]) * delta
+                if signed < -threshold:
+                    row["verdict"] = "REGRESSED"
+                    regressions.append(metric)
+                else:
+                    row["verdict"] = ("improved" if signed > threshold
+                                      else "ok")
+        rows.append(row)
+    return {"kind": "bench-compare", "threshold": threshold, "rows": rows,
+            "regressions": regressions, "removed": removed}
+
+
+def _load_bench_artifact(path: str):
+    """One JSON doc, or — for a captured bench stdout — every JSON line
+    collected into a list (the differ reads both)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    pass
+        if not rows:
+            raise
+        return rows
+
+
+def compare_bench_files(old_path: str, new_path: str, *,
+                        threshold: float = 0.05) -> dict:
+    cmp = compare_bench_runs(_load_bench_artifact(old_path),
+                             _load_bench_artifact(new_path), threshold)
+    cmp["old_file"], cmp["new_file"] = old_path, new_path
+    return cmp
+
+
+def _fmt_compare_cell(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def format_compare_table(cmp: dict) -> str:
+    lines = [f"== bench --compare (threshold {cmp['threshold']:.0%}) ==",
+             f"  {'metric':42s} {'old':>12s} {'new':>12s} "
+             f"{'delta':>8s}  verdict"]
+    for r in cmp["rows"]:
+        delta = (f"{r['delta_pct']:+.1f}%"
+                 if r.get("delta_pct") is not None else "-")
+        unit = f" [{r['unit']}]" if r.get("unit") else ""
+        lines.append(
+            f"  {r['metric'][:42]:42s} {_fmt_compare_cell(r['old']):>12s} "
+            f"{_fmt_compare_cell(r['new']):>12s} {delta:>8s}  "
+            f"{r['verdict']}{unit}")
+    tail = f"  {len(cmp['regressions'])} regression(s)"
+    if cmp["regressions"]:
+        tail += f": {', '.join(cmp['regressions'])}"
+    lines.append(tail)
+    return "\n".join(lines)
 
 
 def main(argv=None):
@@ -3607,7 +3961,29 @@ def main(argv=None):
                    help="run ONLY the per-fusion MFU attribution (device-"
                         "side XLA profiler timing; writes "
                         "MFU_ATTRIBUTION.json)")
+    p.add_argument("--compare", nargs=2, default=None,
+                   metavar=("OLD.json", "NEW.json"),
+                   help="regression differ: per-metric delta table "
+                        "between two bench artifacts (BENCH_full.json, "
+                        "workload lines, or a scoreboard digest); exits "
+                        "1 when any row regresses past "
+                        "--compare-threshold")
+    p.add_argument("--compare-threshold", type=float, default=0.05,
+                   help="relative move against a metric's direction "
+                        "beyond this fraction is a regression "
+                        "(default 0.05)")
     args = p.parse_args(argv)
+
+    if args.compare:
+        cmp = compare_bench_files(args.compare[0], args.compare[1],
+                                  threshold=args.compare_threshold)
+        print(format_compare_table(cmp))
+        # Same final-line contract as the workload path: one
+        # machine-parsable JSON line last.
+        print(json.dumps(_json_safe(cmp), allow_nan=False), flush=True)
+        if cmp["regressions"]:
+            raise SystemExit(1)
+        return cmp
 
     from flink_tensorflow_tpu.utils.platform import enable_compile_cache, force_cpu
 
@@ -3824,6 +4200,18 @@ def _scoreboard(outputs: list) -> dict:
                             sc.get("measured_collective_spans")],
             "analysis_ms": sc.get("analysis_wall_ms"),
         }
+    # roofline digest (PR 17): the plane's per-jit-unit attribution —
+    # top serving MFU and the plane-vs-hand train MFU pair.
+    rf = next((o for o in outputs
+               if str(o.get("metric", "")).startswith("roofline")), None)
+    if rf is not None and rf is not flag:
+        sb["roofline"] = {
+            "device": rf.get("device"),
+            "top_mfu_pct": rf.get("value"),
+            "top_operator": rf.get("top_operator"),
+            "train_mfu_plane_vs_hand": rf.get("train_mfu_pct_plane_vs_hand"),
+            "drift_findings": rf.get("serving_drift_findings"),
+        }
     return sb
 
 
@@ -3834,7 +4222,7 @@ def _fit_scoreboard(sb: dict, limit: int = SCOREBOARD_MAX_BYTES) -> dict:
     add.  The headline metric/value/latency keys are never dropped."""
     droppable = [
         "trace_overhead", "fetch_elided_batches", "wire_bytes_saved",
-        "shardcheck", "workloads", "mfu_sweep_batch_pct",
+        "roofline", "shardcheck", "workloads", "mfu_sweep_batch_pct",
         "wire_ceiling_rps_range", "resnet_train", "bottleneck",
         "open_loop", "wire_mb_s_bracket",
     ]

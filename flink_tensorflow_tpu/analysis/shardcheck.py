@@ -1043,6 +1043,13 @@ def main(argv=None) -> int:
     parser.add_argument("--out", metavar="REPORT.json",
                         help="also write the (last) JSON report here — the "
                              "file flink-tpu-doctor --shardcheck reads")
+    parser.add_argument("--cost-table", metavar="TABLE.json",
+                        help="also price the (last) captured plan "
+                             "(analysis/costmodel: per jit unit, per "
+                             "compile signature — FLOPs, HBM bytes, "
+                             "collective bytes, expected h2d/d2h) and "
+                             "write the CostTable here — the file "
+                             "flink-tpu-roofline --cost-table reads")
     args = parser.parse_args(argv)
 
     from flink_tensorflow_tpu.analysis.capture import capture_pipeline_file
@@ -1050,6 +1057,7 @@ def main(argv=None) -> int:
     job_args = args.job_args.split()
     exit_code = 0
     report = None
+    last_env = None
     for path in args.pipelines:
         try:
             env = capture_pipeline_file(path, job_args)
@@ -1065,6 +1073,7 @@ def main(argv=None) -> int:
         if args.hbm_budget_bytes is not None:
             config = dc.replace(config, hbm_budget_bytes=args.hbm_budget_bytes)
         env.config = config
+        last_env = env
         report = report_for_env(env, pipeline=path)
         if args.json:
             print(json.dumps(report))
@@ -1093,6 +1102,13 @@ def main(argv=None) -> int:
     if args.out and report is not None:
         with open(args.out, "w") as fh:
             json.dump(report, fh, indent=2)
+    if args.cost_table and last_env is not None:
+        from flink_tensorflow_tpu.analysis.costmodel import cost_table_for_env
+
+        table = cost_table_for_env(last_env)
+        with open(args.cost_table, "w") as fh:
+            json.dump(table.to_json(), fh, indent=2)
+        print(f"cost table -> {args.cost_table}")
     return exit_code
 
 

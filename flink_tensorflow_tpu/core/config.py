@@ -251,6 +251,14 @@ class JobConfig:
     #: checkpoint->stop->respawn-at-new-parallelism->rescale-restore
     #: loop.  None (the default) starts no evaluator thread.
     health: typing.Optional[HealthConfig] = None
+    #: Roofline attribution plane (metrics.roofline.RooflineConfig):
+    #: declares the DeviceSpec peak and drift tolerances; the captured
+    #: plan's CostTable (analysis.costmodel) is priced automatically at
+    #: execute() when ``roofline.cost_table`` is None.  Runners join
+    #: measured step times against it and publish per-operator
+    #: ``roofline.*`` gauges + compile events.  None (the default) costs
+    #: nothing at runtime.
+    roofline: typing.Optional[typing.Any] = None
 
     def validate(self) -> "JobConfig":
         if self.parallelism < 1:
@@ -319,4 +327,6 @@ class JobConfig:
         self.checkpoint.validate()
         if self.health is not None:
             self.health.validate()
+        if self.roofline is not None:
+            self.roofline.validate()
         return self

@@ -381,6 +381,23 @@ class StreamExecutionEnvironment:
         # configure(metrics=...) may have changed the seed after the
         # registry was created; histograms pick it up at first use.
         self.metric_registry.seed = cfg.metrics.seed
+        roofline = cfg.roofline
+        if roofline is not None and roofline.cost_table is None:
+            # Price the captured plan once here so every worker (local
+            # subtask or spawned process) joins against the same table.
+            # Fail-soft: an unpriceable plan still runs, the plane just
+            # publishes busy/compile gauges without MFU attribution.
+            import dataclasses as _dc
+
+            try:
+                from flink_tensorflow_tpu.analysis.costmodel import (
+                    cost_table_for_env,
+                )
+
+                roofline = _dc.replace(
+                    roofline, cost_table=cost_table_for_env(self))
+            except Exception:  # noqa: BLE001 — analysis never blocks execution
+                pass
         common = dict(
             channel_capacity=cfg.channel_capacity,
             metric_registry=self.metric_registry,
@@ -409,6 +426,7 @@ class StreamExecutionEnvironment:
             flight_path=cfg.flight_path,
             faults=cfg.faults,
             restart_epoch=restart_epoch,
+            roofline=roofline,
         )
         if cfg.distributed is not None:
             from flink_tensorflow_tpu.core.distributed import DistributedExecutor

@@ -723,6 +723,7 @@ class LocalExecutor:
         flow_control: bool = True,
         faults: typing.Optional[typing.Any] = None,
         restart_epoch: int = 0,
+        roofline: typing.Optional[typing.Any] = None,
     ):
         from flink_tensorflow_tpu import tracing
         from flink_tensorflow_tpu.core import sanitizer_rt
@@ -846,6 +847,18 @@ class LocalExecutor:
                 # no-op path.
                 injector = None
         self.faults = injector
+        #: Roofline attribution plane (metrics/roofline.py):
+        #: JobConfig.roofline declares the DeviceSpec peak and carries
+        #: the plan's CostTable; model runners mint per-operator probes
+        #: off ``ctx.roofline`` and publish ``roofline.*`` gauges +
+        #: compile events.  None (the default) keeps the production path
+        #: at one is-None test per runner.
+        self.roofline = None
+        if roofline is not None:
+            from flink_tensorflow_tpu.metrics.roofline import RooflinePlane
+
+            self.roofline = RooflinePlane(
+                roofline, flight=self.flight, tracer=self.tracer)
         self.device_provider = device_provider
         self.mesh = mesh
         self.job_config = job_config or {}
@@ -1139,6 +1152,10 @@ class LocalExecutor:
             # fault hook (sever/blackhole/delay) from this at open().
             ctx.fault_injector = self.faults
             ctx.restart_epoch = self.restart_epoch
+            # Roofline hand-off: model runners mint a per-operator probe
+            # (static-cost join, roofline.* gauges, compile-event log)
+            # from this at open().
+            ctx.roofline = self.roofline
             if head_gate is not None:
                 # Operator-owned background threads (the model runner's
                 # fetch thread) use this to break the CHAIN's event wait

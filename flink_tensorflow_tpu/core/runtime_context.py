@@ -75,6 +75,12 @@ class RuntimeContext:
         #: RemoteSource; the shuffle writers get it from the executor
         #: directly.
         self.flow_control: bool = True
+        #: Roofline attribution plane (metrics.roofline.RooflinePlane)
+        #: when JobConfig.roofline is declared: model runners mint a
+        #: per-operator probe from it at open() — static-cost join,
+        #: ``roofline.*`` gauges, compile-event log.  None (the default)
+        #: is the zero-cost off path.
+        self.roofline: typing.Optional[typing.Any] = None
 
     def state(self, descriptor: StateDescriptor):
         return self._keyed_state.value_state(descriptor)

@@ -187,6 +187,7 @@ class DecodeStepRunner:
         self._step_exact_fn = None
         self._metrics = None
         self._tracer = None
+        self._roofline = None
         self._trace_track: typing.Optional[str] = None
         #: Plain counters (mirrored to the metric plane when open(ctx)
         #: wired one): the serving tests' residency guards read these.
@@ -206,6 +207,13 @@ class DecodeStepRunner:
             self._tracer = getattr(ctx, "tracer", None)
             if self._tracer is not None:
                 self._trace_track = f"{ctx.task_name}.{ctx.subtask_index}"
+            plane = getattr(ctx, "roofline", None)
+            if plane is not None:
+                # Per-operator roofline probe: joins each measured
+                # prefill/decode step against the plan's CostTable and
+                # publishes roofline.* gauges on this subtask's scope.
+                self._roofline = plane.probe(ctx.task_name,
+                                             metrics=ctx.metrics)
         self._params_on_device = jax.device_put(self.model.params, self.device)
 
         (self._prefill_fn, self._step_full_fn,
@@ -237,6 +245,10 @@ class DecodeStepRunner:
         saved = (self.step_h2d_bytes, self.block_h2d_events,
                  self.block_d2h_events, self.device_block_moves)
         t_warm = time.monotonic()
+        if self._roofline is not None:
+            # Warmup compiles still log compile events (trigger =
+            # "warmup"), but none of the throughput accounting.
+            self._roofline.begin_warmup()
         try:
             for b in admit_buckets:
                 for t in prompt_buckets:
@@ -245,6 +257,8 @@ class DecodeStepRunner:
                                  [self.pool_slots], batch_bucket=b)
             self.decode_step([0] * self.pool_slots, [0] * self.pool_slots, [])
         finally:
+            if self._roofline is not None:
+                self._roofline.end_warmup()
             self._metrics = metrics
             self._tracer = tracer
             (self.step_h2d_bytes, self.block_h2d_events,
@@ -333,6 +347,11 @@ class DecodeStepRunner:
         if self._metrics is not None:
             self._metrics.histogram("prefill_s").record(t1 - t0)
             self._metrics.counter("prefill_batches").inc()
+        if self._roofline is not None:
+            self._roofline.observe(
+                "prefill", t1 - t0, signature=f"prefill:{b}x{t}",
+                h2d_bytes=tokens.nbytes + lens.nbytes + slot_arr.nbytes,
+                d2h_bytes=b * 4)
         return host
 
     def decode_step(self, tokens_by_slot, lengths_by_slot, active_slots):
@@ -348,6 +367,7 @@ class DecodeStepRunner:
         if self._kc is None:
             raise RuntimeError("decode_step before any prefill")
         t0 = time.monotonic()
+        h2d_before = self.step_h2d_bytes
         if self.padding_buckets:
             mask = np.zeros((self.pool_slots,), bool)
             mask[list(active_slots)] = True
@@ -381,6 +401,16 @@ class DecodeStepRunner:
         if self._metrics is not None:
             self._metrics.histogram("decode_step_s").record(t1 - t0)
             self._metrics.counter("decode_steps").inc()
+        if self._roofline is not None:
+            # Padded mode always presents the one [S] signature; exact
+            # mode churns by design — each active-set size is its own
+            # (unpriced, unpredicted) signature.
+            sig = (f"decode:{self.pool_slots}" if self.padding_buckets
+                   else f"decode:{len(active_slots)}")
+            self._roofline.observe(
+                "decode_step", t1 - t0, signature=sig,
+                h2d_bytes=self.step_h2d_bytes - h2d_before,
+                d2h_bytes=int(out.nbytes))
         return out
 
     # -- block movement (keyed-state residency boundary) -------------------
@@ -538,6 +568,10 @@ class CompiledMethodRunner:
         #: untraced (production no-op path).
         self._tracer = None
         self._trace_track: typing.Optional[str] = None
+        #: Roofline probe (metrics/roofline.py) when the executor wired
+        #: a plane through ctx.roofline: each fetched batch's compute
+        #: time joins against the plan's static cost entries.
+        self._roofline = None
 
     # -- lifecycle ---------------------------------------------------------
     def open(self, ctx: typing.Optional["RuntimeContext"] = None) -> None:
@@ -626,6 +660,10 @@ class CompiledMethodRunner:
                 # Track name computed only on the traced path — bare
                 # test contexts carry metrics but no task identity.
                 self._trace_track = f"{ctx.task_name}.{ctx.subtask_index}"
+            plane = getattr(ctx, "roofline", None)
+            if plane is not None:
+                self._roofline = plane.probe(ctx.task_name,
+                                             metrics=ctx.metrics)
 
     def warmup(self, batch_sizes: typing.Iterable[int], length_bucket: int = 128) -> None:
         """Pre-compile executables for the given batch buckets (open-time,
@@ -643,11 +681,17 @@ class CompiledMethodRunner:
         metrics, self._metrics = self._metrics, None
         tracer, self._tracer = self._tracer, None
         t_warm = time.monotonic()
+        if self._roofline is not None:
+            # Compile events still log (trigger = "warmup"); throughput
+            # accounting is suppressed like the metrics above.
+            self._roofline.begin_warmup()
         try:
             for b in batch_sizes:
                 fields = {n: np.zeros(shapes[n], schema[n].dtype) for n in schema.names}
                 self.run_batch([TensorValue(fields)] * b)
         finally:
+            if self._roofline is not None:
+                self._roofline.end_warmup()
             self._metrics = metrics
             self._tracer = tracer
             self.service_ewma_s = None
@@ -1014,6 +1058,14 @@ class CompiledMethodRunner:
                     timings["wire_saved"])
             self._metrics.counter("batches").inc()
             self._metrics.counter("padded_records").inc(batch.padded_size - batch.num_records)
+        if self._roofline is not None:
+            # Busy time = the compute span (launch -> fetch reached);
+            # the padded batch size is the jit signature the cost table
+            # keyed its entries on.
+            self._roofline.observe(
+                self.method.name, t_fetch_start - timings["t_dispatched"],
+                signature=f"b{batch.padded_size}",
+                h2d_bytes=timings["h2d_bytes"])
         return results, on_done
 
     def _complete_device(self, batch, outputs, timings, on_done,
@@ -1068,6 +1120,12 @@ class CompiledMethodRunner:
             self._metrics.counter("batches").inc()
             self._metrics.counter("padded_records").inc(
                 batch.padded_size - batch.num_records)
+        if self._roofline is not None:
+            # block_until_ready IS the compute barrier on this path.
+            self._roofline.observe(
+                self.method.name, t_done - timings["t_dispatched"],
+                signature=f"b{batch.padded_size}",
+                h2d_bytes=timings["h2d_bytes"])
         dbatch = DeviceBatch(outputs, batch.valid, batch.metas,
                              tracer=tracer, track=self._trace_track)
         return [dbatch], on_done

@@ -55,9 +55,16 @@ _SUM_NAMES = frozenset({
     # Chaos/recovery planes (PR 11): per-process abort lists and fault
     # injections add up to the cohort's churn.
     "checkpoints_aborted", "fired_total",
+    # Roofline plane (PR 17): compile events add up to the cohort's
+    # recompile bill.  (flops_per_s / hbm_bytes_per_s / busy_s sum via
+    # the _s suffix — the cohort's aggregate device throughput.)
+    "roofline.compile_events", "roofline.unpredicted_compiles",
 })
 _LAST_NAMES = frozenset({
     "chain_length", "chained_edges", "chain_position", "current_split_id",
+    # Classification code, not a magnitude: any numeric reduction would
+    # invent a bound no process reported.
+    "roofline.bound",
 })
 #: Level/lag gauges whose suffix would otherwise read as accumulated
 #: time: the cohort-wide value is the WORST process, not the sum.
@@ -70,6 +77,10 @@ _MAX_NAMES = frozenset({
     # "latest completed" is the highest id any process reports (a peer
     # mid-restore may briefly trail).
     "last_checkpoint_id",
+    # Utilization percentages and per-call averages: the cohort answer
+    # is the hottest (or most divergent) process, never the sum.
+    "roofline.mfu_pct", "roofline.membw_pct", "roofline.h2d_drift_frac",
+    "roofline.measured_h2d_per_call", "roofline.predicted_h2d_per_call",
 })
 # Not in any table by design: per-edge "reconnects" and recovery's
 # "restarts_total"/"edge_reconnects" are counters/meters (they sum

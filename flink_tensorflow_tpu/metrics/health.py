@@ -302,6 +302,24 @@ def default_rules(*, channel_capacity: int = 1024) -> typing.Tuple[SloRule, ...]
         SloRule("checkpoint-aborts", "checkpoints_aborted",
                 scope="recovery", warn=0.01, breach=0.2, mode="rate",
                 sustain=2),
+        # Roofline plane (metrics/roofline.py; rules on absent metrics
+        # never fire, so these cost nothing without JobConfig.roofline).
+        # MFU collapse: a model operator's achieved FLOP/s fell to noise
+        # against the declared DeviceSpec peak — the device is starved
+        # (host/input bound), which more parallelism upstream fixes.
+        # Long sustain so warmup/drain phases don't trip it.
+        SloRule("mfu-collapse", "roofline.mfu_pct", cmp="<",
+                warn=5.0, breach=1.0, sustain=10, clear_after=3,
+                action="scale_up"),
+        # Predicted-vs-measured h2d divergence: the plan's static
+        # transfer accounting no longer matches what the runner ships.
+        SloRule("roofline-drift", "roofline.h2d_drift_frac",
+                warn=0.25, breach=1.0, sustain=3),
+        # Unpredicted recompiles per second: shapes outside the plan's
+        # compile-signature ladder reaching the device (recompile churn
+        # the serving-recompile-churn lint warned about, now measured).
+        SloRule("roofline-recompile", "roofline.unpredicted_compiles",
+                warn=0.05, breach=1.0, mode="rate", sustain=2),
     )
 
 

@@ -196,8 +196,27 @@ def build_live_rows(snapshot: typing.Dict[str, typing.Dict[str, typing.Any]]) ->
             "idle_s": _finite(m.get("idle_s")),
             "watermark_lag_s": _finite(m.get("watermark_lag_s")),
             "splits_completed": m.get("splits_completed"),
+            # Roofline plane (metrics/roofline.py): model operators under
+            # JobConfig.roofline publish MFU against the declared
+            # DeviceSpec peak and a bound classification; None keeps the
+            # column out of the table entirely.
+            "mfu_pct": _finite(m.get("roofline.mfu_pct")),
+            "bound": _bound_name(m.get("roofline.bound")),
         })
     return rows
+
+
+def _bound_name(code: typing.Any) -> typing.Optional[str]:
+    """``roofline.bound`` gauge code -> "compute"/"memory"/"host"/"wire"
+    (None when the operator publishes no roofline gauges)."""
+    if isinstance(code, bool) or not isinstance(code, (int, float)):
+        return None
+    from flink_tensorflow_tpu.metrics.roofline import BOUND_NAMES
+
+    idx = int(code)
+    if 0 <= idx < len(BOUND_NAMES):
+        return BOUND_NAMES[idx]
+    return None
 
 
 def _health_name(state: typing.Any) -> typing.Optional[str]:
@@ -215,10 +234,16 @@ def _health_name(state: typing.Any) -> typing.Optional[str]:
 
 def format_live_table(rows: typing.Sequence[Row]) -> str:
     # The health column only appears when some row carries a verdict —
-    # jobs without JobConfig.health keep the pre-health layout.
+    # jobs without JobConfig.health keep the pre-health layout.  Same
+    # rule for the roofline columns (JobConfig.roofline unset = the
+    # pre-roofline layout).
     with_health = any(r.get("health") is not None for r in rows)
+    with_roofline = any(r.get("mfu_pct") is not None
+                        or r.get("bound") is not None for r in rows)
     header = ["operator", "in", "in/s", "out/s", "queue", "q.hwm",
               "bp s", "idle s", "wm lag s"]
+    if with_roofline:
+        header += ["mfu%", "bound"]
     if with_health:
         header.append("health")
     body = [[
@@ -231,7 +256,9 @@ def format_live_table(rows: typing.Sequence[Row]) -> str:
         _fmt(r["backpressure_s"], digits=2),
         _fmt(r["idle_s"], digits=2),
         _fmt(r["watermark_lag_s"], digits=3),
-    ] + ([r.get("health") or "-"] if with_health else [])
+    ] + ([_fmt(r.get("mfu_pct"), digits=2), r.get("bound") or "-"]
+         if with_roofline else [])
+      + ([r.get("health") or "-"] if with_health else [])
         for r in rows]
     widths = [max(len(h), *(len(b[i]) for b in body)) if body else len(h)
               for i, h in enumerate(header)]

@@ -5,6 +5,7 @@ per-operator latency-attribution table.
     flink-tpu-trace examples/mnist_lenet.py --out lenet.trace.json
     flink-tpu-trace --from-file lenet.trace.json   # re-attribute a capture
     flink-tpu-trace --cohort t.proc0.json t.proc1.json --out merged.json
+    flink-tpu-trace --cohort t           # auto-discovers t.proc<k>.json
     flink-tpu-trace --from-flight-dump flight.json  # replay a crash ring
 
 Captures the pipeline's plan the same way the analyzer/inspector CLIs do
@@ -63,6 +64,32 @@ def trace_pipeline(
     }
 
 
+def expand_proc_files(paths: typing.Sequence[str]) -> typing.List[str]:
+    """Resolve trace-file arguments to concrete paths: an existing file
+    passes through; a glob pattern expands; a bare prefix ``P``
+    discovers its ``P.proc<k>*`` per-process siblings (the names the
+    distributed executor writes).  Expansions order by process index —
+    not lexicographically, where proc10 would sort before proc2 — so
+    the cohort stitcher sees process 0 first."""
+    import glob as globmod
+    import os
+    import re
+
+    def proc_key(path: str) -> typing.Tuple[int, str]:
+        m = re.search(r"\.proc(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else -1, path)
+
+    out: typing.List[str] = []
+    for p in paths:
+        if os.path.exists(p):
+            out.append(p)
+            continue
+        matches = (globmod.glob(p) if any(ch in p for ch in "*?[")
+                   else globmod.glob(f"{p}.proc*"))
+        out.extend(sorted(matches, key=proc_key) or [p])
+    return out
+
+
 def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="flink-tpu-trace",
@@ -104,21 +131,27 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.cohort:
-        if len(args.pipelines) < 2:
-            parser.error("--cohort needs >= 2 per-process trace files")
+        # A glob or a bare prefix auto-discovers the .proc<k> files the
+        # distributed executor wrote, in process order.
+        files = expand_proc_files(args.pipelines)
+        if len(files) < 2:
+            parser.error(
+                "--cohort needs >= 2 per-process trace files "
+                f"(arguments resolved to {files or 'nothing'} — pass the "
+                "files, a glob, or the bare path prefix before .proc<k>)")
         from flink_tensorflow_tpu.tracing.stitch import (
             cross_process_traces,
             merge_cohort_trace_files,
         )
 
-        merged = merge_cohort_trace_files(args.pipelines)
+        merged = merge_cohort_trace_files(files)
         out = args.out or "cohort.trace.json"
         with open(out, "w") as f:
             json.dump(merged, f)
         events = events_from_chrome(merged)
         stitched = cross_process_traces(merged)
         attr = attribution(events)
-        print(f"== merged {len(args.pipelines)} process traces -> {out} "
+        print(f"== merged {len(files)} process traces -> {out} "
               f"({len(events)} events, {len(stitched)} cross-process "
               f"traces, clock error bound "
               f"{merged['cohort_merge']['max_error_bound_s'] * 1e6:.0f}us) ==")
@@ -160,13 +193,21 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
         return 0
 
     if args.from_file is not None:
-        with open(args.from_file) as f:
-            events = events_from_chrome(json.load(f))
+        # A glob or a bare .proc<k> prefix attributes the whole set of
+        # per-process files at once (unstitched — use --cohort for the
+        # clock-corrected merge).
+        files = expand_proc_files([args.from_file])
+        events = []
+        for path in files:
+            with open(path) as f:
+                events.extend(events_from_chrome(json.load(f)))
+        events.sort(key=lambda ev: ev[3])
         attr = attribution(events)
         print(format_attribution_table(attr))
         if not args.table_only:
-            print(json.dumps({"trace_file": args.from_file,
-                              "events": len(events), "attribution": attr}))
+            print(json.dumps({
+                "trace_file": files[0] if len(files) == 1 else files,
+                "events": len(events), "attribution": attr}))
         return 0
 
     if not args.pipelines:

@@ -260,6 +260,28 @@ def shardcheck_findings(report: typing.Optional[dict]
     return out
 
 
+def roofline_findings(report: typing.Optional[dict]) -> typing.List[str]:
+    """Roofline drift verdicts (``flink-tpu-roofline --out``) folded
+    into doctor findings: measured-vs-predicted divergence and
+    unpredicted recompiles are runtime-vs-plan proof, ranked with the
+    static shardcheck verdicts; the top headroom row rides along as the
+    "where the seconds went" context for the statistical signals."""
+    if not report:
+        return []
+    out = [f"roofline [{f.get('rule', '?')}] {f.get('operator', '?')}: "
+           f"{f.get('message', '')}"
+           for f in report.get("findings", ())]
+    rows = report.get("rows") or ()
+    if rows:
+        r = rows[0]  # already ranked by recoverable headroom
+        out.append(
+            f"roofline headroom: {r.get('operator', '?')} leads with "
+            f"{r.get('headroom_s', 0):.2f}s recoverable "
+            f"({r.get('bound', '-')}-bound at {r.get('mfu_pct', 0):.1f}% "
+            "MFU)")
+    return out
+
+
 def diagnose(
     snapshot: typing.Optional[Snapshot] = None,
     *,
@@ -268,6 +290,7 @@ def diagnose(
     decision: typing.Optional[dict] = None,
     sanitizer_report: typing.Optional[dict] = None,
     shardcheck_report: typing.Optional[dict] = None,
+    roofline_report: typing.Optional[dict] = None,
     channel_capacity: int = 1024,
     top: int = 3,
 ) -> typing.Dict[str, typing.Any]:
@@ -287,8 +310,10 @@ def diagnose(
     actions = supervisor_actions(flight_docs, decision)
     san_findings = sanitizer_findings(sanitizer_report)
     shard_findings = shardcheck_findings(shardcheck_report)
+    roof_findings = roofline_findings(roofline_report)
 
-    findings: typing.List[str] = list(san_findings) + list(shard_findings)
+    findings: typing.List[str] = (list(san_findings) + list(shard_findings)
+                                  + list(roof_findings))
     named: typing.Set[str] = set()
     for rank, b in enumerate(bottlenecks[:top], start=1):
         op = b["operator"]
@@ -347,6 +372,7 @@ def diagnose(
         "actions": actions,
         "sanitizer": san_findings,
         "shardcheck": shard_findings,
+        "roofline": roof_findings,
     }
 
 
@@ -401,6 +427,11 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                              "(flink-tpu-shardcheck --out): plan-level "
                              "layout/donation/HBM verdicts fold in after "
                              "protocol violations")
+    parser.add_argument("--roofline", default=None, metavar="REPORT.json",
+                        help="roofline report (flink-tpu-roofline --out): "
+                             "MFU/headroom context and predicted-vs-"
+                             "measured drift findings fold in after the "
+                             "static shardcheck verdicts")
     parser.add_argument("--channel-capacity", type=int, default=1024,
                         help="channel capacity the queue-depth thresholds "
                              "scale against (default 1024)")
@@ -417,6 +448,7 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     flight_docs: typing.List[dict] = []
     sanitizer_report: typing.Optional[dict] = None
     shardcheck_report: typing.Optional[dict] = None
+    roofline_report: typing.Optional[dict] = None
     loaded = 0
     try:
         if args.snapshot:
@@ -454,6 +486,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                 raise ValueError(f"{args.shardcheck}: not a shardcheck "
                                  "report")
             loaded += 1
+        if args.roofline:
+            with open(args.roofline) as f:
+                roofline_report = json.load(f)
+            if not isinstance(roofline_report, dict):
+                raise ValueError(f"{args.roofline}: not a roofline "
+                                 "report")
+            loaded += 1
     except (OSError, ValueError) as ex:
         print(f"flink-tpu-doctor: unreadable evidence: {ex}",
               file=sys.stderr)
@@ -470,13 +509,15 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
         loaded += 1
     if not loaded:
         parser.error("provide at least one of --snapshot / --flight / "
-                     "--trace / --decision / --sanitizer / --shardcheck")
+                     "--trace / --decision / --sanitizer / --shardcheck / "
+                     "--roofline")
     events.sort(key=lambda ev: ev[3])
 
     report = diagnose(
         snapshot, events=events, flight_docs=flight_docs,
         decision=decision, sanitizer_report=sanitizer_report,
         shardcheck_report=shardcheck_report,
+        roofline_report=roofline_report,
         channel_capacity=args.channel_capacity,
         top=args.top,
     )

@@ -1,0 +1,190 @@
+"""bench.py --compare (ISSUE 17 satellite): the scoreboard differ's
+direction rules, regression verdicts and exit codes, plus the slow-CI
+guard that re-runs the roofline workload and diffs the fresh numbers
+against the committed BENCH_r14.json artifact."""
+
+import argparse
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+
+import bench
+
+
+def rows(cmp_doc, verdict=None):
+    out = cmp_doc["rows"]
+    if verdict is not None:
+        out = [r for r in out if r["verdict"] == verdict]
+    return out
+
+
+def by_metric(cmp_doc, metric):
+    (row,) = [r for r in cmp_doc["rows"] if r["metric"] == metric]
+    return row
+
+
+class TestDirectionRules:
+    def test_latency_units_are_lower_better(self):
+        for metric, unit in [("p99", "ms"), ("step", "s"), ("x", "us"),
+                             ("y", "ns"), ("spill", "bytes"), ("z", "B")]:
+            assert bench._metric_direction(metric, unit) == -1
+
+    def test_latency_names_are_lower_better(self):
+        assert bench._metric_direction("serve_latency_p50", "") == -1
+        assert bench._metric_direction("span_record_ns", None) == -1
+        assert bench._metric_direction("h2d_bytes", "") == -1
+
+    def test_throughput_defaults_higher_better(self):
+        assert bench._metric_direction("records_per_sec", "rec/s") == 1
+        assert bench._metric_direction("mfu_pct", "%") == 1
+
+
+class TestCompare:
+    OLD = {"workloads": [
+        {"metric": "records_per_sec", "value": 1000.0, "unit": "rec/s"},
+        {"metric": "serve_p99_ms", "value": 10.0, "unit": "ms"},
+        {"metric": "gone_metric", "value": 1.0, "unit": ""},
+    ]}
+
+    def new(self, rps, p99, extra=None):
+        docs = [
+            {"metric": "records_per_sec", "value": rps, "unit": "rec/s"},
+            {"metric": "serve_p99_ms", "value": p99, "unit": "ms"},
+        ]
+        if extra:
+            docs.append(extra)
+        return {"workloads": docs}
+
+    def test_ok_within_threshold(self):
+        cmp_doc = bench.compare_bench_runs(self.OLD, self.new(990.0, 10.2))
+        assert cmp_doc["kind"] == "bench-compare"
+        assert cmp_doc["regressions"] == []
+        assert by_metric(cmp_doc, "records_per_sec")["verdict"] == "ok"
+
+    def test_throughput_drop_regresses(self):
+        cmp_doc = bench.compare_bench_runs(self.OLD, self.new(800.0, 10.0))
+        row = by_metric(cmp_doc, "records_per_sec")
+        assert row["verdict"] == "REGRESSED"
+        assert row["delta_pct"] == pytest.approx(-20.0)
+        assert cmp_doc["regressions"] == ["records_per_sec"]
+
+    def test_latency_rise_regresses_but_drop_improves(self):
+        worse = bench.compare_bench_runs(self.OLD, self.new(1000.0, 13.0))
+        assert by_metric(worse, "serve_p99_ms")["verdict"] == "REGRESSED"
+        better = bench.compare_bench_runs(self.OLD, self.new(1000.0, 7.0))
+        assert by_metric(better, "serve_p99_ms")["verdict"] == "improved"
+        assert better["regressions"] == []
+
+    def test_added_and_removed_never_fail_alone(self):
+        cmp_doc = bench.compare_bench_runs(
+            self.OLD,
+            self.new(1000.0, 10.0,
+                     extra={"metric": "brand_new", "value": 5.0, "unit": ""}))
+        assert [r["metric"] for r in rows(cmp_doc, "added")] == ["brand_new"]
+        assert cmp_doc["removed"] == ["gone_metric"]
+        assert cmp_doc["regressions"] == []
+
+    def test_custom_threshold(self):
+        cmp_doc = bench.compare_bench_runs(self.OLD, self.new(940.0, 10.0),
+                                           threshold=0.10)
+        assert cmp_doc["regressions"] == []
+
+    def test_scoreboard_digest_docs_compare(self):
+        old = {"workloads": {"throughput": [1000.0, "rec/s"]},
+               "elapsed_s": 1.0}
+        new = {"workloads": {"throughput": [500.0, "rec/s"]},
+               "elapsed_s": 1.0}
+        cmp_doc = bench.compare_bench_runs(old, new)
+        assert by_metric(cmp_doc, "throughput")["verdict"] == "REGRESSED"
+
+    def test_format_table_mentions_verdicts(self):
+        cmp_doc = bench.compare_bench_runs(self.OLD, self.new(800.0, 7.0))
+        table = bench.format_compare_table(cmp_doc)
+        assert "REGRESSED" in table and "improved" in table
+        assert "records_per_sec" in table
+
+
+class TestCompareCli:
+    def write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_exit_1_on_regression_0_on_clean(self, tmp_path, capsys):
+        old = self.write(tmp_path, "old.json", TestCompare.OLD)
+        clean = self.write(tmp_path, "new.json", TestCompare.OLD)
+        bench.main(["--compare", old, clean])  # no SystemExit => clean
+        capsys.readouterr()
+        bad = self.write(tmp_path, "bad.json", {"workloads": [
+            {"metric": "records_per_sec", "value": 1.0, "unit": "rec/s"},
+            {"metric": "serve_p99_ms", "value": 10.0, "unit": "ms"},
+        ]})
+        with pytest.raises(SystemExit) as exc:
+            bench.main(["--compare", old, bad])
+        assert exc.value.code == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out
+        assert json.loads(out.strip().rsplit("\n", 1)[-1])["regressions"]
+
+    def test_jsonl_artifact_loads(self, tmp_path):
+        p = tmp_path / "runs.jsonl"
+        p.write_text(
+            '{"metric": "a", "value": 1.0, "unit": ""}\n'
+            '{"metric": "b", "value": 2.0, "unit": "ms"}\n')
+        assert set(bench._bench_rows(bench._load_bench_artifact(str(p))))\
+            == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# slow-CI guard: fresh roofline run vs the committed BENCH_r14.json
+# ---------------------------------------------------------------------------
+
+
+def _guard_rows(detail):
+    """Distill a roofline bench detail doc to the deterministic facts the
+    guard diffs: structure and plan-vs-runtime agreement, not timings."""
+    serving_leg = detail["serving"]
+    train = detail["resnet50_train"]
+    return {"workloads": [
+        {"metric": "serving_operator_rows", "unit": "",
+         "value": float(len(serving_leg["rows"]))},
+        {"metric": "serving_findings_clean", "unit": "",
+         "value": 1.0 if not serving_leg["findings"] else 0.0},
+        {"metric": "train_flops_static_over_xla", "unit": "",
+         "value": float(train["flops_static_over_xla"])},
+        {"metric": "unpredicted_compiles_clean", "unit": "",
+         "value": 1.0 if not any(
+             r.get("unpredicted_compiles") for r in serving_leg["rows"])
+         and not train.get("unpredicted_compiles") else 0.0},
+    ]}
+
+
+@pytest.mark.slow
+def test_roofline_bench_matches_committed_artifact(tmp_path, monkeypatch):
+    if not os.path.exists(bench.BENCH_R14_PATH):
+        pytest.skip("no committed BENCH_r14.json to guard against")
+    with open(bench.BENCH_R14_PATH) as f:
+        committed = json.load(f)
+
+    # Re-book into a scratch path so the committed artifact is the
+    # baseline, never the output.
+    monkeypatch.setattr(bench, "BENCH_R14_PATH",
+                        str(tmp_path / "BENCH_r14.json"))
+    args = argparse.Namespace(records=None, smoke=True, chaining="on",
+                              sanitize="off", trace="off",
+                              device_resident="off", wire_dtype=None)
+    row = bench.bench_roofline(args)
+    with open(bench.BENCH_R14_PATH) as f:
+        fresh = json.load(f)
+
+    cmp_doc = bench.compare_bench_runs(
+        _guard_rows(committed), _guard_rows(fresh), threshold=0.5)
+    assert cmp_doc["removed"] == [], bench.format_compare_table(cmp_doc)
+    assert cmp_doc["regressions"] == [], bench.format_compare_table(cmp_doc)
+    # The plane itself must reproduce the booked MFU figure, not hand math.
+    assert row["metric"].startswith("roofline")
+    assert row["value"] is not None and row["value"] > 0
