@@ -340,9 +340,26 @@ def _cost_serving(t, op) -> OperatorCost:
         k_like = pf_out["k_cache"]  # [B, L, T, H, Dh]
         _, layers, _, heads, hd = k_like.shape
         pool_dtype = np.dtype(k_like.dtype)
-        kc = jax.ShapeDtypeStruct((S, layers, C, heads, hd), pool_dtype)
-        prefill_into, step_full, _ = _build_decode_calls(
-            prefill.fn, decode.fn, C)
+        paged = bool(getattr(cfg, "paged_kv", False))
+        if paged:
+            from flink_tensorflow_tpu.functions.runner import (
+                _build_paged_calls,
+            )
+            from flink_tensorflow_tpu.ops.paged_attention import (
+                pages_per_session,
+            )
+
+            pt = cfg.page_tokens
+            Pc = pages_per_session(C, pt)  # table width per session
+            P = cfg.resolved_hbm_pages()
+            kp = jax.ShapeDtypeStruct(
+                (P, layers, pt, heads, hd), pool_dtype)
+            prefill_into, step_full, _ = _build_paged_calls(
+                prefill.fn, decode.fn, C, pt, P)
+        else:
+            kc = jax.ShapeDtypeStruct((S, layers, C, heads, hd), pool_dtype)
+            prefill_into, step_full, _ = _build_decode_calls(
+                prefill.fn, decode.fn, C)
         combos = [(b, min(n, C)) for (kind, b, n) in sigs
                   if kind == "prefill"]
         combos = sorted(set(combos))
@@ -355,26 +372,67 @@ def _cost_serving(t, op) -> OperatorCost:
         for b, n in combos:
             tok = jax.ShapeDtypeStruct((b, n), np.int32)
             lens = jax.ShapeDtypeStruct((b,), np.int32)
-            slots = jax.ShapeDtypeStruct((b,), np.int32)
-            closed = jax.make_jaxpr(prefill_into)(
-                params_struct, tok, lens, slots, kc, kc)
-            # Mirrors DecodeStepRunner.prefill: tokens + lengths + slot
-            # vector up, [B] next-tokens down.
+            if paged:
+                tables = jax.ShapeDtypeStruct((b, Pc), np.int32)
+                closed = jax.make_jaxpr(prefill_into)(
+                    params_struct, tok, lens, tables, kp, kp)
+                # Paged prefill: the scatter table [b, Pc] int32 rides
+                # up instead of the [b] slot vector.
+                h2d = b * n * 4 + b * 4 + b * Pc * 4
+            else:
+                slots = jax.ShapeDtypeStruct((b,), np.int32)
+                closed = jax.make_jaxpr(prefill_into)(
+                    params_struct, tok, lens, slots, kc, kc)
+                # Mirrors DecodeStepRunner.prefill: tokens + lengths +
+                # slot vector up, [B] next-tokens down.
+                h2d = b * n * 4 + b * 4 + b * 4
             cost.entries.append(_entry_of(
                 "prefill", serving_signature("prefill", b, n), closed,
-                h2d_bytes=b * n * 4 + b * 4 + b * 4, d2h_bytes=b * 4))
-        st_closed = jax.make_jaxpr(step_full)(
-            params_struct,
-            jax.ShapeDtypeStruct((S,), np.int32),
-            jax.ShapeDtypeStruct((S,), np.int32),
-            jax.ShapeDtypeStruct((S,), np.bool_),
-            kc, kc)
-        # Mirrors decode_step under padding buckets: [S] int32 tokens +
-        # [S] int32 lengths + [S] bool mask up, [S] next-tokens down —
-        # the BENCH_r13 72 B = 72.0 B check, generalized.
+                h2d_bytes=h2d, d2h_bytes=b * 4))
+        if paged:
+            st_closed = jax.make_jaxpr(step_full)(
+                params_struct,
+                jax.ShapeDtypeStruct((S,), np.int32),
+                jax.ShapeDtypeStruct((S,), np.int32),
+                jax.ShapeDtypeStruct((S, Pc), np.int32),
+                kp, kp)
+            # Paged decode: block tables ARE host state, re-serialized
+            # every step — [S, Pc] int32 replaces the dense [S] bool
+            # active mask (liveness rides the sentinel page id).
+            step_h2d = S * 4 + S * 4 + S * Pc * 4
+        else:
+            st_closed = jax.make_jaxpr(step_full)(
+                params_struct,
+                jax.ShapeDtypeStruct((S,), np.int32),
+                jax.ShapeDtypeStruct((S,), np.int32),
+                jax.ShapeDtypeStruct((S,), np.bool_),
+                kc, kc)
+            # Mirrors decode_step under padding buckets: [S] int32
+            # tokens + [S] int32 lengths + [S] bool mask up, [S]
+            # next-tokens down — the BENCH_r13 72 B check, generalized.
+            step_h2d = S * 4 + S * 4 + S * 1
         cost.entries.append(_entry_of(
             "decode_step", serving_signature("decode", S, 1), st_closed,
-            h2d_bytes=S * 4 + S * 4 + S * 1, d2h_bytes=S * 4))
+            h2d_bytes=step_h2d, d2h_bytes=S * 4))
+        # cache_move entries price the tier machinery's data motion
+        # (park/extract/insert/spill revival).  Transfers are not
+        # executables, so these deliberately stay OUT of
+        # predicted_signatures — observing one must never count as a
+        # compile-ladder miss.
+        esz = pool_dtype.itemsize
+        if paged:
+            page_bytes = 2 * layers * pt * heads * hd * esz
+            for n_pages in range(1, Pc + 1):
+                cost.entries.append(CostEntry(
+                    unit="cache_move",
+                    signature=f"cache:pages:{n_pages}",
+                    h2d_bytes=n_pages * page_bytes,
+                    d2h_bytes=n_pages * page_bytes))
+        else:
+            block_bytes = 2 * layers * C * heads * hd * esz
+            cost.entries.append(CostEntry(
+                unit="cache_move", signature="cache:block",
+                h2d_bytes=block_bytes, d2h_bytes=block_bytes))
     except Exception as ex:  # noqa: BLE001 - fail-soft by contract
         cost.notes.append(f"abstract pricing failed: {ex!r}")
     return cost

@@ -925,6 +925,76 @@ def _serving_recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
         )
 
 
+@rule("kv-pool-undersized", Severity.WARN)
+def _kv_pool_undersized(ctx: AnalysisContext, emit: Emit) -> None:
+    """Open-loop session traffic against a serving plane with no KV
+    tier valve.  An open-loop paced source keeps offering NEW sessions
+    on its arrival schedule regardless of completion pace, while
+    admission is bounded by ``max_active_seqs`` slots per subtask: once
+    the offered rate exceeds what those slots can possibly turn over
+    (even at one full generation per slot per second), the backlog
+    grows without bound — and every budget preemption parks another
+    session's KV block (HBM-resident under the default
+    ``device_resident_blocks``) with nothing draining it.  The paged
+    plane exists for exactly this shape: ``ServingConfig.paged_kv``
+    bounds HBM at ``hbm_pages`` and the tier ladder demotes cold
+    sessions HBM -> host -> disk instead of accumulating them."""
+    try:
+        from flink_tensorflow_tpu.sources.paced import PacedSplitSource
+    except Exception:  # pragma: no cover - import cycle guard
+        PacedSplitSource = ()  # type: ignore[assignment]
+    for t in ctx.order:
+        op = ctx.operators.get(t.id)
+        if not getattr(op, "is_continuous_batching", False):
+            continue
+        cfg = getattr(op, "serving_config", None)
+        if cfg is None:
+            continue
+        tiered = bool(getattr(cfg, "paged_kv", False)) and bool(
+            getattr(cfg, "tiering", True))
+        if tiered:
+            continue
+        # Transitive upstream walk: the paced source may sit behind
+        # key_by / map stages.
+        stack = list(t.inputs)
+        seen: typing.Set[int] = set()
+        while stack:
+            upstream = stack.pop().upstream
+            if upstream.id in seen:
+                continue
+            seen.add(upstream.id)
+            stack.extend(upstream.inputs)
+            up_op = ctx.operators.get(upstream.id)
+            source = None
+            for attr in ("function", "source"):
+                feed = getattr(up_op, attr, None)
+                if feed is not None and (
+                        isinstance(feed, PacedSplitSource)
+                        or getattr(feed, "is_open_loop", False)):
+                    source = feed
+                    break
+            if source is None:
+                continue
+            rate_hz = getattr(source, "rate_hz", 0.0) or 0.0
+            offered = rate_hz * max(1, upstream.parallelism)
+            bound = cfg.max_active_seqs * max(1, t.parallelism)
+            if offered > bound:
+                fix = ("enable ServingConfig.paged_kv (+ tiering and a "
+                       "spill_dir)"
+                       if not getattr(cfg, "paged_kv", False)
+                       else "re-enable ServingConfig.tiering")
+                emit(
+                    f"open-loop source offers ~{offered:g} sessions/s "
+                    f"against {bound} admission slots "
+                    f"({cfg.max_active_seqs} max_active_seqs x "
+                    f"{t.parallelism} subtasks) with no KV tier valve — "
+                    "the backlog's preempted caches accumulate without "
+                    f"bound; {fix} so pressure demotes sessions "
+                    "HBM -> host -> disk instead",
+                    node=t.name,
+                )
+
+
 @rule("recompile-churn", Severity.WARN)
 def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
     """Shape-signature churn at jit boundaries: several distinct schemas

@@ -616,7 +616,20 @@ def _audit_serving_operator(
         k_like = pf_out["k_cache"]  # [B, L, T, H, Dh]
         _, layers, _, heads, hd = k_like.shape
         pool_dtype = np.dtype(k_like.dtype)
-        pool_shape = (S, layers, C, heads, hd)
+        paged = bool(getattr(cfg, "paged_kv", False))
+        if paged:
+            from flink_tensorflow_tpu.ops.paged_attention import (
+                pages_per_session,
+            )
+
+            # The paged HBM budget is the PAGE pool, not seats x
+            # capacity — oversubscription is the whole economy; the
+            # overflow lives in the host/disk tiers, not in HBM.
+            Pc = pages_per_session(C, cfg.page_tokens)
+            P = cfg.resolved_hbm_pages()
+            pool_shape = (P, layers, cfg.page_tokens, heads, hd)
+        else:
+            pool_shape = (S, layers, C, heads, hd)
         pool_bytes = 2 * int(math.prod(pool_shape)) * pool_dtype.itemsize
         pool_div = 1
         if mesh_axes and layout.tp_axis:
@@ -637,17 +650,33 @@ def _audit_serving_operator(
         audit.hbm["kv_pool"] = pool_bytes // pool_div
         # The runtime jit units, verbatim (module-level lru_cache: the
         # live runner will reuse these callables and executables).
-        prefill_into, step_full, _ = _build_decode_calls(
-            prefill.fn, decode.fn, C)
         kc = jax.ShapeDtypeStruct(pool_shape, pool_dtype)
-        slots = jax.ShapeDtypeStruct((B,), np.int32)
         s_tok = jax.ShapeDtypeStruct((S,), np.int32)
         s_len = jax.ShapeDtypeStruct((S,), np.int32)
-        mask = jax.ShapeDtypeStruct((S,), np.bool_)
-        pf_closed = jax.make_jaxpr(prefill_into)(
-            params_struct, tok, lens, slots, kc, kc)
-        st_closed = jax.make_jaxpr(step_full)(
-            params_struct, s_tok, s_len, mask, kc, kc)
+        if paged:
+            from flink_tensorflow_tpu.functions.runner import (
+                _build_paged_calls,
+            )
+
+            prefill_into, step_full, _ = _build_paged_calls(
+                prefill.fn, decode.fn, C, cfg.page_tokens, P)
+            pf_tables = jax.ShapeDtypeStruct((B, Pc), np.int32)
+            st_tables = jax.ShapeDtypeStruct((S, Pc), np.int32)
+            pf_closed = jax.make_jaxpr(prefill_into)(
+                params_struct, tok, lens, pf_tables, kc, kc)
+            st_closed = jax.make_jaxpr(step_full)(
+                params_struct, s_tok, s_len, st_tables, kc, kc)
+            st_args = (params_struct, s_tok, s_len, st_tables, kc, kc)
+        else:
+            prefill_into, step_full, _ = _build_decode_calls(
+                prefill.fn, decode.fn, C)
+            slots = jax.ShapeDtypeStruct((B,), np.int32)
+            mask = jax.ShapeDtypeStruct((S,), np.bool_)
+            pf_closed = jax.make_jaxpr(prefill_into)(
+                params_struct, tok, lens, slots, kc, kc)
+            st_closed = jax.make_jaxpr(step_full)(
+                params_struct, s_tok, s_len, mask, kc, kc)
+            st_args = (params_struct, s_tok, s_len, mask, kc, kc)
         for closed in (pf_closed, st_closed):
             for name, n in count_collectives(closed).items():
                 audit.collectives[name] = audit.collectives.get(name, 0) + n
@@ -657,8 +686,7 @@ def _audit_serving_operator(
         # donate_argnums=(4, 5) (kc, vc) and step_full's jnp.where keeps
         # the pool shape — so the only way to lose the aliasing is a
         # dtype drift between the model's decode cache and the pool.
-        step_out = jax.eval_shape(step_full, params_struct,
-                                  s_tok, s_len, mask, kc, kc)
+        step_out = jax.eval_shape(step_full, *st_args)
         out_k = step_out[1]
         if np.dtype(out_k.dtype) != pool_dtype or tuple(out_k.shape) != pool_shape:
             findings.append(Finding(
@@ -674,8 +702,12 @@ def _audit_serving_operator(
         # DecodeStepRunner.decode_step's accounting exactly (the
         # predicted-vs-measured bench leg diffs this against the
         # runtime step_h2d_bytes counter): padding_buckets on ships
-        # [S] int32 tokens + [S] int32 lengths + [S] bool mask.
-        if cfg.padding_buckets:
+        # [S] int32 tokens + [S] int32 lengths + [S] bool mask; the
+        # paged runner ships the [S, C/page_tokens] int32 block tables
+        # instead of the mask (liveness rides the sentinel page id).
+        if paged:
+            audit.predicted_step_h2d_bytes = S * 4 + S * 4 + S * Pc * 4
+        elif cfg.padding_buckets:
             audit.predicted_step_h2d_bytes = S * 4 + S * 4 + S * 1
         else:
             audit.predicted_step_h2d_bytes = None  # exact mode: varies

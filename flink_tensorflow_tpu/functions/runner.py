@@ -215,7 +215,9 @@ class DecodeStepRunner:
                 self._roofline = plane.probe(ctx.task_name,
                                              metrics=ctx.metrics)
         self._params_on_device = jax.device_put(self.model.params, self.device)
+        self._build_calls()
 
+    def _build_calls(self) -> None:
         (self._prefill_fn, self._step_full_fn,
          self._step_exact_fn) = _build_decode_calls(
             self._prefill.fn, self._decode.fn, self.capacity)
@@ -436,7 +438,16 @@ class DecodeStepRunner:
         self.block_d2h_events += 1
         if self._tracer is not None:
             self._tracer.span(self._trace_track, "cache.d2h", t0, t1,
-                              args={"slot": slot, "length": length})
+                              args={"slot": slot, "length": length,
+                                    "bytes": int(k.nbytes + v.nbytes)})
+        if self._roofline is not None:
+            # Tier-move transfer: priced against the plan's cache_move
+            # entries WITHOUT minting a compile event — block moves are
+            # data motion, not executables (the PR-17 "non-runner h2d
+            # attribution" deferral).
+            self._roofline.observe_transfer(
+                "cache_move", t1 - t0, signature="cache:block",
+                d2h_bytes=int(k.nbytes + v.nbytes))
         return k, v
 
     def insert_block(self, slot: int, k, v) -> None:
@@ -458,12 +469,533 @@ class DecodeStepRunner:
             self.block_h2d_events += 1
             if self._tracer is not None:
                 self._tracer.span(self._trace_track, "cache.h2d", t0, t1,
-                                  args={"slot": slot})
+                                  args={"slot": slot,
+                                        "bytes": int(k.nbytes + v.nbytes)})
+            if self._roofline is not None:
+                self._roofline.observe_transfer(
+                    "cache_move", t1 - t0, signature="cache:block",
+                    h2d_bytes=int(k.nbytes + v.nbytes))
         else:
             self.device_block_moves += 1
             if self._tracer is not None:
                 self._tracer.instant(self._trace_track, "cache.resident",
                                      args={"slot": slot})
+
+
+@functools.lru_cache(maxsize=64)
+def _build_paged_calls(prefill_fn, decode_fn, capacity: int,
+                       page_tokens: int, num_pages: int):
+    """Jitted (paged_prefill_into, paged_step, copy_page) per (model
+    methods, capacity, page geometry) — module-level cache for the same
+    reason as :func:`_build_decode_calls`: restarted jobs, comparison
+    bench arms, and parallel subtasks all reuse the compiled
+    executables.
+
+    The paged step is gather -> dense kernel -> scatter
+    (ops/paged_attention.py): the decode/prefill MATH is byte-for-byte
+    the model's existing methods over a materialized dense view, which
+    is what makes paged output bit-identical to the dense pool on the
+    same schedule.  Sentinel table entries (``num_pages``) clamp on
+    gather (garbage masked by lengths) and drop on scatter, so inactive
+    rows, bucket-padding rows, and prefix-SHARED pages (sentinel in the
+    prefill scatter table — the first writer's bytes stay authoritative)
+    all ride the one padded signature with no mask argument."""
+    import jax
+
+    from flink_tensorflow_tpu.ops.paged_attention import (
+        gather_pages,
+        scatter_pages,
+    )
+
+    def prefill_into(params, tokens, lengths, tables, kp, vp):
+        import jax.numpy as jnp
+
+        out = prefill_fn(params, {"tokens": tokens, "lengths": lengths})
+        t = tokens.shape[1]
+        pad = capacity - t
+        k_new, v_new = out["k_cache"], out["v_cache"]
+        if pad:
+            widths = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+            k_new = jnp.pad(k_new, widths)
+            v_new = jnp.pad(v_new, widths)
+        kp = scatter_pages(kp, tables, k_new, page_tokens)
+        vp = scatter_pages(vp, tables, v_new, page_tokens)
+        return out["next_token"], kp, vp
+
+    def step(params, tokens, lengths, tables, kp, vp):
+        kc = gather_pages(kp, tables)
+        vc = gather_pages(vp, tables)
+        out = decode_fn(params, {
+            "token": tokens, "lengths": lengths,
+            "k_cache": kc, "v_cache": vc,
+        })
+        kp = scatter_pages(kp, tables, out["k_cache"], page_tokens)
+        vp = scatter_pages(vp, tables, out["v_cache"], page_tokens)
+        return out["next_token"], kp, vp
+
+    def copy_page(src, dst, kp, vp):
+        # The copy-on-write split: duplicate one page device-side
+        # before a write into shared bytes.  Scalar int32 src/dst trace
+        # once — one executable for every split.
+        return kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])
+
+    return (jax.jit(prefill_into, donate_argnums=(4, 5)),
+            jax.jit(step, donate_argnums=(4, 5)),
+            jax.jit(copy_page, donate_argnums=(2, 3)))
+
+
+class PagedDecodeStepRunner(DecodeStepRunner):
+    """Paged variant of :class:`DecodeStepRunner`: the HBM pool is
+    ``num_pages`` fixed-size pages ``[P, L, page_tokens, H, Dh]`` and
+    every active slot carries a block table instead of owning a
+    contiguous ``[L, C, H, Dh]`` row.
+
+    What changes at the dispatch boundary: the per-step int32 h2d grows
+    the ``[S, C/page_tokens]`` block tables alongside the token/length
+    vectors (the tables ARE host state — they re-serialize every step,
+    which is what keeps them out of the donation cycle), the pool is
+    still donated through the jitted step, and admission needs FREE
+    PAGES, not a slot-shaped hole.  The host-side policy objects
+    (:class:`~flink_tensorflow_tpu.serving.paged.PagedKVPool` free
+    list/refcounts, the radix prefix index) live on this runner; the
+    serving operator drives them through the block-movement methods
+    below (park/attach for hot preemption, insert/extract for the
+    warm/cold tiers, ``ensure_writable`` for the copy-on-write check
+    before each step's write position).
+
+    Paged mode requires ``padding_buckets`` — the whole point is ONE
+    decode signature over the padded pool; exact-shape churn would
+    recompile per active-set size with the table width riding along."""
+
+    def __init__(
+        self,
+        model: Model,
+        *,
+        pool_slots: int,
+        capacity: int,
+        page_tokens: int = 16,
+        num_pages: typing.Optional[int] = None,
+        prefix_sharing: bool = True,
+        padding_buckets: bool = True,
+        prompt_buckets: typing.Optional[typing.Sequence[int]] = None,
+        device=None,
+    ):
+        from flink_tensorflow_tpu.ops.paged_attention import (
+            pages_per_session,
+        )
+        from flink_tensorflow_tpu.serving.paged import (
+            PagedKVPool,
+            RadixPrefixIndex,
+        )
+
+        if not padding_buckets:
+            raise ValueError(
+                "paged KV requires padding_buckets — the paged step has "
+                "exactly one [S, C/page_tokens] signature by design")
+        super().__init__(model, pool_slots=pool_slots, capacity=capacity,
+                         padding_buckets=padding_buckets,
+                         prompt_buckets=prompt_buckets, device=device)
+        self.page_tokens = page_tokens
+        self.table_width = pages_per_session(capacity, page_tokens)
+        self.num_pages = (num_pages if num_pages is not None
+                          else pool_slots * self.table_width)
+        if self.num_pages < self.table_width:
+            raise ValueError(
+                f"hbm_pages {self.num_pages} cannot seat even one "
+                f"full-capacity session ({self.table_width} pages) — "
+                "grow the pool or shrink capacity")
+        self.pool = PagedKVPool(self.num_pages, page_tokens)
+        self.index = RadixPrefixIndex(self.pool) if prefix_sharing else None
+        #: Active slot -> block table (logical page i at position i).
+        self._tables: typing.Dict[int, typing.List[int]] = {}
+        self._paged_prefill_fn = None
+        self._paged_step_fn = None
+        self._copy_page_fn = None
+
+    def _build_calls(self) -> None:
+        (self._paged_prefill_fn, self._paged_step_fn,
+         self._copy_page_fn) = _build_paged_calls(
+            self._prefill.fn, self._decode.fn, self.capacity,
+            self.page_tokens, self.num_pages)
+
+    def close(self) -> None:
+        super().close()
+        self._paged_prefill_fn = self._paged_step_fn = None
+        self._copy_page_fn = None
+        self._tables.clear()
+
+    # -- pool geometry -----------------------------------------------------
+    def _ensure_pool(self, k_like) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._kc is not None:
+            return
+        _, layers, _, heads, hd = k_like.shape
+        shape = (self.num_pages, layers, self.page_tokens, heads, hd)
+        # Two DISTINCT buffers, same donation reasoning as the dense pool.
+        self._kc = jax.device_put(jnp.zeros(shape, k_like.dtype), self.device)
+        self._vc = jax.device_put(jnp.zeros(shape, k_like.dtype), self.device)
+
+    def page_nbytes(self) -> typing.Optional[int]:
+        """K+V bytes of ONE page (None before the pool is built)."""
+        if self._kc is None:
+            return None
+        per = 1
+        for d in self._kc.shape[1:]:
+            per *= d
+        return 2 * per * self._kc.dtype.itemsize
+
+    def _alloc(self, n: int) -> typing.Optional[typing.List[int]]:
+        """Allocate ``n`` pages, evicting index-only pages LRU under
+        pressure; None when the pool is genuinely out (the caller's
+        tier machinery demotes parked sessions and retries)."""
+        if n <= 0:
+            return []
+        got = self.pool.alloc(n)
+        if got is None and self.index is not None:
+            self.index.evict_until(n)
+            got = self.pool.alloc(n)
+        return got
+
+    def free_pages_evictable(self) -> int:
+        """Free pages plus what index eviction could free — the
+        admission gate's optimistic bound."""
+        free = self.pool.free_pages
+        if self.index is not None:
+            free += sum(1 for _, _, node in self.index._leaves()
+                        if self.pool.refs[node.page] == 1)
+        return free
+
+    # -- dispatch ----------------------------------------------------------
+    def prefill(self, prompts: typing.Sequence, lengths: typing.Sequence[int],
+                slots: typing.Sequence[int],
+                *, batch_bucket: typing.Optional[int] = None):
+        """Paged prefill: per session, adopt prefix pages from the
+        radix index (refcount bump, zero compute), allocate the rest,
+        and scatter the freshly computed K/V ONLY into owned pages (the
+        scatter table carries the sentinel where pages are shared —
+        the first writer's bytes stay authoritative, which is the
+        byte-identity argument for prefix sharing)."""
+        import jax
+        import numpy as np
+
+        n = len(prompts)
+        b = batch_bucket or n
+        t = self._bucket_len(max(int(x) for x in lengths))
+        tokens = np.zeros((b, t), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+        lens = np.zeros((b,), np.int32)
+        lens[:n] = np.asarray(lengths, np.int32)
+        # Scatter tables: sentinel everywhere a page is NOT owned by the
+        # prefilling session (pad rows, beyond-allocation, adopted).
+        scatter = np.full((b, self.table_width), self.num_pages, np.int32)
+        adopted_pages = 0
+        for i, (p, ln, slot) in enumerate(zip(prompts, lengths, slots)):
+            slot = int(slot)
+            if slot >= self.pool_slots:
+                continue  # warmup pad row: all-sentinel, pure compile
+            adopted: typing.List[int] = []
+            if self.index is not None:
+                full, partial = self.index.match(p)
+                adopted = full + ([partial] if partial is not None else [])
+            own_n = self.pool.pages_for(int(ln)) - len(adopted)
+            own = self._alloc(own_n)
+            if own is None:
+                self.pool.release(adopted)
+                raise RuntimeError(
+                    f"paged KV pool exhausted at prefill: need {own_n} "
+                    f"pages, {self.pool.free_pages} free — the admission "
+                    "gate should have held this session back")
+            table = adopted + own
+            self._tables[slot] = table
+            adopted_pages += len(adopted)
+            for j in range(len(adopted), len(table)):
+                scatter[i, j] = table[j]
+        t0 = time.monotonic()
+        if self._kc is None:
+            # Bootstrap: one raw prefill to learn the cache shape (same
+            # one-extra-compile cost as the dense runner's first call).
+            out = jax.jit(self._prefill.fn)(
+                self._params_on_device,
+                {"tokens": jax.device_put(tokens, self.device),
+                 "lengths": jax.device_put(lens, self.device)})
+            self._ensure_pool(out["k_cache"])
+        next_tok, self._kc, self._vc = self._paged_prefill_fn(
+            self._params_on_device,
+            jax.device_put(tokens, self.device),
+            jax.device_put(lens, self.device),
+            jax.device_put(scatter, self.device),
+            self._kc, self._vc,
+        )
+        host = np.asarray(jax.device_get(next_tok))[:n]
+        t1 = time.monotonic()
+        h2d = tokens.nbytes + lens.nbytes + scatter.nbytes
+        self.step_h2d_bytes += h2d
+        if self._tracer is not None:
+            self._tracer.span(self._trace_track, "decode.prefill", t0, t1,
+                              args={"batch": n, "bucket": [b, t],
+                                    "pages_shared": adopted_pages})
+        if self._metrics is not None:
+            self._metrics.histogram("prefill_s").record(t1 - t0)
+            self._metrics.counter("prefill_batches").inc()
+        if self._roofline is not None:
+            self._roofline.observe(
+                "prefill", t1 - t0, signature=f"prefill:{b}x{t}",
+                h2d_bytes=h2d, d2h_bytes=b * 4)
+        return host
+
+    def decode_step(self, tokens_by_slot, lengths_by_slot, active_slots):
+        """One paged decode step: the block tables ride the per-step
+        int32 h2d alongside the token/length vectors; rows without a
+        table (inactive, warmup) go all-sentinel and no-op through the
+        gather/scatter."""
+        import jax
+        import numpy as np
+
+        if self._kc is None:
+            raise RuntimeError("decode_step before any prefill")
+        t0 = time.monotonic()
+        s = self.pool_slots
+        tables = np.full((s, self.table_width), self.num_pages, np.int32)
+        for slot, table in self._tables.items():
+            tables[slot, :len(table)] = table
+        toks = np.asarray(tokens_by_slot, np.int32)
+        lens = np.asarray(lengths_by_slot, np.int32)
+        h2d = toks.nbytes + lens.nbytes + tables.nbytes
+        self.step_h2d_bytes += h2d
+        next_tok, self._kc, self._vc = self._paged_step_fn(
+            self._params_on_device,
+            jax.device_put(toks, self.device),
+            jax.device_put(lens, self.device),
+            jax.device_put(tables, self.device),
+            self._kc, self._vc)
+        out = np.asarray(jax.device_get(next_tok))
+        t1 = time.monotonic()
+        if self._tracer is not None:
+            self._tracer.span(self._trace_track, "decode.step", t0, t1,
+                              args={"active": len(active_slots)})
+        if self._metrics is not None:
+            self._metrics.histogram("decode_step_s").record(t1 - t0)
+            self._metrics.counter("decode_steps").inc()
+        if self._roofline is not None:
+            self._roofline.observe(
+                "decode_step", t1 - t0, signature=f"decode:{s}",
+                h2d_bytes=h2d, d2h_bytes=int(out.nbytes))
+        return out
+
+    # -- copy-on-write / growth -------------------------------------------
+    def ensure_writable(self, slot: int, length: int) -> bool:
+        """Guarantee the page holding write position ``length`` exists
+        and is exclusively owned before the step runs.  Allocates the
+        next page at a page boundary; splits a shared page
+        (copy-on-write) when the write would land in bytes the prefix
+        index or another session still references.  False = the pool is
+        out of pages even after index eviction — the operator's tier
+        machinery must free pressure and retry."""
+        table = self._tables[slot]
+        li = length // self.page_tokens
+        while len(table) <= li:
+            got = self._alloc(1)
+            if got is None:
+                return False
+            table.extend(got)
+        pid = table[li]
+        if self.pool.is_shared(pid):
+            got = self._alloc(1)
+            if got is None:
+                return False
+            self._copy_page(pid, got[0])
+            self.pool.decref(pid)
+            self.pool.cow_splits += 1
+            table[li] = got[0]
+        return True
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        import numpy as np
+
+        self._kc, self._vc = self._copy_page_fn(
+            np.int32(src), np.int32(dst), self._kc, self._vc)
+        if self._tracer is not None:
+            self._tracer.instant(self._trace_track, "cache.cow",
+                                 args={"src": src, "dst": dst})
+
+    # -- block movement (tier-ladder boundary) -----------------------------
+    def park(self, slot: int, length: int):
+        """Hot preemption: the session's pages STAY in HBM behind a
+        :class:`~flink_tensorflow_tpu.serving.paged.PagedKVHandle`;
+        only the block table leaves the step batch.  Zero traffic —
+        the paged analogue of the dense device-resident preemption."""
+        from flink_tensorflow_tpu.serving.paged import PagedKVHandle
+
+        table = self._tables.pop(slot)
+        self.device_block_moves += 1
+        if self._tracer is not None:
+            self._tracer.instant(self._trace_track, "cache.resident",
+                                 args={"slot": slot, "length": length,
+                                       "pages": len(table)})
+        return PagedKVHandle(table, length)
+
+    def attach(self, slot: int, handle) -> None:
+        """Re-admission of a hot-parked session: re-attach the table."""
+        self._tables[slot] = list(handle.pages)
+        self.device_block_moves += 1
+        if self._tracer is not None:
+            self._tracer.instant(self._trace_track, "cache.resident",
+                                 args={"slot": slot, "pages":
+                                       len(handle.pages)})
+
+    def _gather_host(self, pages: typing.Sequence[int], length: int):
+        """Pages -> dense host ``[L, C, H, Dh]`` K/V (zero-fill beyond
+        the allocated pages; positions past ``length`` are masked by
+        every consumer).  Returns ``(k, v, wire_bytes)`` — only the
+        gathered pages cross the wire; the capacity pad is minted
+        host-side and must not count as transfer traffic."""
+        import jax
+        import numpy as np
+
+        from flink_tensorflow_tpu.ops.paged_attention import pages_to_dense
+
+        ids = np.asarray(pages, np.int32)
+        k_pages, v_pages = jax.device_get(
+            (self._kc[ids], self._vc[ids]))
+        wire_bytes = int(k_pages.nbytes + v_pages.nbytes)
+        k = pages_to_dense(np.asarray(k_pages)[None])[0]
+        v = pages_to_dense(np.asarray(v_pages)[None])[0]
+        layers, got, heads, hd = k.shape
+        if got < self.capacity:
+            pad = np.zeros((layers, self.capacity - got, heads, hd), k.dtype)
+            k = np.concatenate([k, pad], axis=1)
+            v = np.concatenate([v, pad], axis=1)
+        return k, v, wire_bytes
+
+    def snapshot_block(self, slot: int, length: int):
+        """Barrier copy of an ACTIVE session: dense host K/V, pages
+        untouched (the pool stays authoritative — same contract as the
+        dense ``extract_block(host=True)`` at a barrier)."""
+        t0 = time.monotonic()
+        k, v, wire = self._gather_host(self._tables[slot], length)
+        t1 = time.monotonic()
+        self.block_d2h_events += 1
+        n = len(self._tables[slot])
+        if self._tracer is not None:
+            self._tracer.span(self._trace_track, "cache.d2h", t0, t1,
+                              args={"slot": slot, "length": length,
+                                    "pages": n, "bytes": wire})
+        if self._roofline is not None:
+            self._roofline.observe_transfer(
+                "cache_move", t1 - t0, signature=f"cache:pages:{n}",
+                d2h_bytes=wire)
+        return k, v
+
+    def extract_host(self, slot: int, length: int):
+        """Demotion of an ACTIVE session (pressure preemption to the
+        warm tier): dense host K/V out, pages released."""
+        table = self._tables.pop(slot)
+        t0 = time.monotonic()
+        k, v, wire = self._gather_host(table, length)
+        t1 = time.monotonic()
+        self.pool.release(table)
+        self.block_d2h_events += 1
+        if self._tracer is not None:
+            self._tracer.span(self._trace_track, "cache.d2h", t0, t1,
+                              args={"slot": slot, "length": length,
+                                    "pages": len(table), "bytes": wire})
+        if self._roofline is not None:
+            self._roofline.observe_transfer(
+                "cache_move", t1 - t0,
+                signature=f"cache:pages:{len(table)}",
+                d2h_bytes=wire)
+        return k, v
+
+    def demote_handle(self, handle):
+        """Hot -> warm: a PARKED session's pages gather d2h into a host
+        :class:`~flink_tensorflow_tpu.serving.kv_cache.KVBlock` and
+        free."""
+        from flink_tensorflow_tpu.serving.kv_cache import KVBlock
+
+        t0 = time.monotonic()
+        k, v, wire = self._gather_host(handle.pages, handle.length)
+        t1 = time.monotonic()
+        self.pool.release(handle.pages)
+        self.block_d2h_events += 1
+        if self._tracer is not None:
+            self._tracer.span(self._trace_track, "cache.d2h", t0, t1,
+                              args={"length": handle.length,
+                                    "pages": len(handle.pages),
+                                    "bytes": wire})
+        if self._roofline is not None:
+            self._roofline.observe_transfer(
+                "cache_move", t1 - t0,
+                signature=f"cache:pages:{len(handle.pages)}",
+                d2h_bytes=wire)
+        return KVBlock(k, v, handle.length)
+
+    def insert_block(self, slot: int, k, v,
+                     length: typing.Optional[int] = None) -> None:
+        """Warm/cold revival: a host block's exact bytes back into
+        freshly allocated pages (the admission gate reserved them).
+        ``length`` bounds the pages allocated — a full-capacity scatter
+        would waste pages on masked positions."""
+        import jax
+        import numpy as np
+
+        from flink_tensorflow_tpu.ops.paged_attention import dense_to_pages
+
+        if length is None:
+            length = k.shape[1]
+        if self._kc is None:
+            import jax.numpy as jnp
+
+            self._ensure_pool(jnp.asarray(k)[None])
+        n = self.pool.pages_for(int(length))
+        got = self._alloc(n)
+        if got is None:
+            raise RuntimeError(
+                f"paged KV pool exhausted at re-admission: need {n} "
+                f"pages, {self.pool.free_pages} free — the admission "
+                "gate should have held this session back")
+        self._tables[slot] = got
+        ids = np.asarray(got, np.int32)
+        k_pages = dense_to_pages(np.asarray(k)[None], self.page_tokens)[0][:n]
+        v_pages = dense_to_pages(np.asarray(v)[None], self.page_tokens)[0][:n]
+        t0 = time.monotonic()
+        self._kc = self._kc.at[ids].set(jax.device_put(k_pages, self.device))
+        self._vc = self._vc.at[ids].set(jax.device_put(v_pages, self.device))
+        t1 = time.monotonic()
+        self.block_h2d_events += 1
+        if self._tracer is not None:
+            self._tracer.span(self._trace_track, "cache.h2d", t0, t1,
+                              args={"slot": slot, "pages": n,
+                                    "bytes": int(k_pages.nbytes
+                                                 + v_pages.nbytes)})
+        if self._roofline is not None:
+            self._roofline.observe_transfer(
+                "cache_move", t1 - t0, signature=f"cache:pages:{n}",
+                h2d_bytes=int(k_pages.nbytes + v_pages.nbytes))
+
+    def release_finished(self, slot: int, cached_tokens,
+                         length: int) -> None:
+        """A finished session leaves the pool: its FULL pages publish to
+        the prefix index (keyed by the token sequence that produced
+        them — future sessions sharing the prefix adopt instead of
+        recompute), everything else frees."""
+        table = self._tables.pop(slot)
+        if self.index is not None:
+            self.index.publish(cached_tokens, table)
+        self.pool.release(table)
+
+    # -- legacy interface guards ------------------------------------------
+    def extract_block(self, slot: int, length: int, *, host: bool):
+        """The dense runner's extraction split maps onto the paged
+        world as snapshot (host copy, pages keep) — the only dense call
+        site that reaches a paged runner is the barrier hook."""
+        if not host:
+            raise RuntimeError(
+                "paged preemption parks pages (park()/attach()); "
+                "device-resident extract_block is a dense-pool concept")
+        return self.snapshot_block(slot, length)
 
 
 class _FetchError:

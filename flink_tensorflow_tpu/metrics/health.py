@@ -320,6 +320,19 @@ def default_rules(*, channel_capacity: int = 1024) -> typing.Tuple[SloRule, ...]
         # the serving-recompile-churn lint warned about, now measured).
         SloRule("roofline-recompile", "roofline.unpredicted_compiles",
                 warn=0.05, breach=1.0, mode="rate", sustain=2),
+        # Paged KV economy (serving/paged.py; absent without
+        # ServingConfig.paged_kv so dense plans never score these).
+        # Sustained pool occupancy near the ceiling: admissions start
+        # stalling behind the page gate and every decode-step growth
+        # risks a forced demotion — more HBM pages or more subtasks.
+        SloRule("kv-pool-pressure", "kv_page_occupancy_pct",
+                warn=85.0, breach=95.0, sustain=2, action="scale_up"),
+        # Tier churn: demote/spill/revive transitions per second.  A
+        # sustained high rate means the pool thrashes sessions across
+        # the HBM/host/disk ladder instead of serving them — the paging
+        # analogue of swap thrash.
+        SloRule("kv-tier-thrash", "kv_tier_moves", warn=5.0,
+                breach=50.0, mode="rate", sustain=2, action="scale_up"),
     )
 
 

@@ -56,6 +56,9 @@ BOUND_NONE, BOUND_COMPUTE, BOUND_MEMORY, BOUND_HOST, BOUND_WIRE = range(5)
 #: Span names whose duration counts as device-busy time when a roofline
 #: report is built from a trace instead of live gauges.
 COMPUTE_SPAN_NAMES = frozenset({"compute", "decode.step", "decode.prefill"})
+#: Cache-movement spans (warm-tier extract/insert, paged demote/revive):
+#: joined against ``cache_move`` cost entries, never against compiles.
+CACHE_SPAN_NAMES = frozenset({"cache.h2d", "cache.d2h"})
 
 
 # ---------------------------------------------------------------------------
@@ -244,6 +247,43 @@ class RooflineProbe:
                 self.h2d_predicted_paired += entry.h2d_bytes
                 self.h2d_paired_calls += 1
 
+    def observe_transfer(self, unit: str, busy_s: float, *,
+                         signature: typing.Optional[str] = None,
+                         h2d_bytes: int = 0, d2h_bytes: int = 0) -> None:
+        """Attribute one measured cache move (warm-tier extraction,
+        spilled-session revival, paged block insert).
+
+        Transfers are NOT jit launches: no compile event is minted and
+        there is no first-sight suppression — the first spill pays the
+        same wire time as the hundredth, so suppressing it would bias
+        the duty cycle exactly when tiering churn matters most.  Busy
+        time still accrues (a runner drowning in cache moves IS
+        wire-bound and :meth:`bound` should say so), and measured bytes
+        pair against the plan's ``cache_move`` entries to feed the same
+        drift gauges the per-step h2d feeds."""
+        if self._warmup:
+            return
+        now = time.monotonic()
+        if self._t_first is None:
+            self._t_first = now - busy_s
+        self.busy_s += busy_s
+        moved = h2d_bytes + d2h_bytes
+        if not moved:
+            return
+        self.h2d_bytes += moved
+        self.h2d_calls += 1
+        entry = (self.op_cost.entry(unit, signature)
+                 if self.op_cost is not None else None)
+        if entry is not None:
+            # cache_move entries price both directions; pair against
+            # whichever side this call actually crossed.
+            predicted = (entry.h2d_bytes if h2d_bytes
+                         else getattr(entry, "d2h_bytes", 0))
+            if predicted:
+                self.h2d_measured_paired += moved
+                self.h2d_predicted_paired += predicted
+                self.h2d_paired_calls += 1
+
     def _record_compile(self, unit: str, signature: str) -> None:
         """A jit cache miss (first sight of a signature): provenance to
         the flight recorder + trace, diffed against the predicted
@@ -312,7 +352,9 @@ class RooflineProbe:
         wire_busy = (self.h2d_bytes / self.busy_s
                      / spec.peak_h2d_bytes_per_s)
         if not self.flops and not self.hbm_bytes:
-            return BOUND_NONE  # no cost entry joined — nothing to rank
+            # No compute entry joined.  Pure cache traffic (an operator
+            # that only ever moved blocks) still ranks as wire-bound.
+            return BOUND_WIRE if self.h2d_bytes else BOUND_NONE
         if wire_busy > max(mfu_busy, membw_busy):
             return BOUND_WIRE
         return BOUND_COMPUTE if mfu_busy >= membw_busy else BOUND_MEMORY
@@ -409,7 +451,7 @@ def rows_from_trace(events: typing.Sequence[tuple],
     per_op: typing.Dict[str, dict] = {}
     for ev in events:
         track, name, ph, ts, dur, args = ev[:6]
-        if ph != "X" or name not in COMPUTE_SPAN_NAMES:
+        if ph != "X" or name not in (COMPUTE_SPAN_NAMES | CACHE_SPAN_NAMES):
             continue
         node = str(track).rsplit(".", 1)[0]
         acc = per_op.setdefault(node, {
@@ -419,10 +461,27 @@ def rows_from_trace(events: typing.Sequence[tuple],
         acc["t0"] = min(acc["t0"], ts)
         acc["t1"] = max(acc["t1"], ts + dur)
         oc = table.op(node) if table is not None else None
+        args = args or {}
+        if name in CACHE_SPAN_NAMES:
+            # Cache moves join measured bytes from the span itself and
+            # predicted bytes from the plan's cache_move entries — the
+            # drift pair the PR-17 deferral left open for non-runner
+            # h2d attribution.
+            measured = int(args.get("bytes", 0) or 0)
+            if measured:
+                acc["h2d"] += measured
+                acc["calls"] += 1
+                if oc is not None:
+                    sig = (f"cache:pages:{args['pages']}"
+                           if args.get("pages") else "cache:block")
+                    entry = oc.entry("cache_move", sig)
+                    if entry is not None:
+                        acc["pred_h2d"] += (entry.h2d_bytes
+                                            or entry.d2h_bytes)
+            continue
         if oc is None:
             continue
         entry = None
-        args = args or {}
         if name == "decode.prefill" and args.get("bucket"):
             b, t = args["bucket"]
             entry = oc.entry("prefill", serving_signature("prefill", b, t))

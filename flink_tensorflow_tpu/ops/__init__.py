@@ -5,6 +5,14 @@ from flink_tensorflow_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_decode,
 )
+from flink_tensorflow_tpu.ops.paged_attention import (
+    dense_to_pages,
+    gather_pages,
+    paged_attention_decode,
+    pages_per_session,
+    pages_to_dense,
+    scatter_pages,
+)
 from flink_tensorflow_tpu.ops.preprocessing import (
     central_crop,
     inception_normalize,
@@ -16,6 +24,12 @@ from flink_tensorflow_tpu.ops.preprocessing import (
 __all__ = [
     "flash_attention",
     "flash_attention_decode",
+    "dense_to_pages",
+    "gather_pages",
+    "paged_attention_decode",
+    "pages_per_session",
+    "pages_to_dense",
+    "scatter_pages",
     "central_crop",
     "inception_normalize",
     "mnist_normalize",

@@ -1,0 +1,95 @@
+"""Paged KV-cache layout ops (vLLM-style block tables, jnp gather path).
+
+The paged pool stores K/V as ``[P, L, page_tokens, H, Dh]`` — P fixed-
+size pages, each holding ``page_tokens`` positions of one session's
+cache — and every session carries an int32 **block table** of width
+``capacity // page_tokens`` mapping its logical page index to a pool
+page (or to the sentinel ``P`` for unallocated entries).
+
+Layout transforms, not math: the decode/prefill math stays in the
+model's existing methods (which flash_attention_decode deliberately
+keeps as plain jnp — a one-row query leaves the MXU idle either way,
+see ops/flash_attention.py), and the paged step is gather -> dense
+kernel -> scatter.  The gather clamps sentinel entries (the garbage it
+reads sits at positions >= the session's length, masked inside the
+attention); the scatter drops them (``mode="drop"``), so a row whose
+table is all-sentinel is a perfect no-op — that is how inactive batch
+rows and bucket-padding rows ride the one padded step signature without
+a separate mask argument, and how prefix-SHARED pages are protected
+from a prefill rewrite (the scatter table carries the sentinel where
+the gather table carries the shared page id).
+"""
+
+from __future__ import annotations
+
+
+def pages_per_session(capacity: int, page_tokens: int) -> int:
+    """Block-table width: logical pages covering one session's capacity."""
+    if capacity % page_tokens:
+        raise ValueError(
+            f"capacity {capacity} must be a multiple of page_tokens "
+            f"{page_tokens} — pages tile the cache exactly")
+    return capacity // page_tokens
+
+
+def dense_to_pages(x, page_tokens: int):
+    """``[B, L, C, H, Dh]`` dense caches -> ``[B, C/pt, L, pt, H, Dh]``
+    page-major form (the scatter payload: axis 1 indexes the block
+    table)."""
+    b, layers, cap, heads, hd = x.shape
+    n = cap // page_tokens
+    x = x.reshape(b, layers, n, page_tokens, heads, hd)
+    return x.transpose(0, 2, 1, 3, 4, 5)
+
+
+def pages_to_dense(x):
+    """Inverse of :func:`dense_to_pages`: ``[B, N, L, pt, H, Dh]`` ->
+    ``[B, L, N*pt, H, Dh]``."""
+    b, n, layers, pt, heads, hd = x.shape
+    x = x.transpose(0, 2, 1, 3, 4, 5)
+    return x.reshape(b, layers, n * pt, heads, hd)
+
+
+def gather_pages(pool, tables):
+    """Materialize dense ``[B, L, C, H, Dh]`` caches from the paged pool.
+
+    ``pool``: ``[P, L, pt, H, Dh]``; ``tables``: ``[B, N]`` int32 with
+    sentinel ``P`` for unallocated entries — clamped to the last page,
+    whose content lands at positions the caller's lengths mask."""
+    import jax.numpy as jnp
+
+    idx = jnp.minimum(tables, pool.shape[0] - 1)
+    return pages_to_dense(pool[idx])
+
+
+def scatter_pages(pool, tables, dense, page_tokens: int):
+    """Write dense ``[B, L, C, H, Dh]`` caches back through the block
+    tables.  Sentinel entries drop; duplicate page ids (prefix-shared
+    pages gathered by several sessions) all write the identical gathered
+    bytes, so write order never matters — the one page that receives NEW
+    content each step is exclusively owned by the copy-on-write
+    invariant the pool enforces before the step runs."""
+    return pool.at[tables].set(dense_to_pages(dense, page_tokens),
+                               mode="drop")
+
+
+def paged_attention_decode(q, k_pool, v_pool, tables, lengths):
+    """Single-query decode attention straight off the paged pool.
+
+    ``q``: ``[B, H, Dh]``; pools ``[P, L, pt, H, Dh]`` sliced per layer
+    by the caller — here the pools are expected PRE-sliced to one layer
+    ``[P, pt, H, Dh]``; ``tables``: ``[B, N]``.  Composes the gather
+    with :func:`~flink_tensorflow_tpu.ops.flash_attention.flash_attention_decode`
+    so the paged layout and the dense decode kernel stay bit-identical
+    by construction (the unit tests assert exactly that)."""
+    import jax.numpy as jnp
+
+    from flink_tensorflow_tpu.ops.flash_attention import (
+        flash_attention_decode,
+    )
+
+    p, pt, heads, hd = k_pool.shape
+    idx = jnp.minimum(tables, p - 1)
+    k = k_pool[idx].reshape(tables.shape[0], tables.shape[1] * pt, heads, hd)
+    v = v_pool[idx].reshape(tables.shape[0], tables.shape[1] * pt, heads, hd)
+    return flash_attention_decode(q, k, v, lengths)

@@ -17,6 +17,12 @@ other keyed state.  The pieces:
   entry point).
 - :mod:`baseline` — ``FixedWindowGenerateFunction``, the fixed
   count-window comparison arm the bench measures against.
+- :mod:`paged` — ``PagedKVPool`` (page-granular HBM cache economy with
+  per-session block tables) and ``RadixPrefixIndex`` (sessions sharing
+  a prompt prefix share pages, copy-on-write at divergence).
+- :mod:`tiering` — ``SessionTierManager``, the HBM -> host -> disk
+  residency ladder (hot parked pages, warm host blocks, cold spill
+  files revived byte-identically).
 
 The decode hot path runs through
 :class:`~flink_tensorflow_tpu.functions.runner.DecodeStepRunner`: the
@@ -36,10 +42,19 @@ from flink_tensorflow_tpu.serving.operator import (
     ContinuousBatchingOperator,
     continuous_batching,
 )
+from flink_tensorflow_tpu.serving.paged import (
+    PagedKVHandle,
+    PagedKVPool,
+    RadixPrefixIndex,
+)
 from flink_tensorflow_tpu.serving.records import GenerateRequest, TokenEvent
 from flink_tensorflow_tpu.serving.scheduler import (
     ServingConfig,
     TokenBudgetScheduler,
+)
+from flink_tensorflow_tpu.serving.tiering import (
+    SessionTierManager,
+    SpilledKVBlock,
 )
 
 __all__ = [
@@ -49,8 +64,13 @@ __all__ = [
     "GenerateRequest",
     "KVBlock",
     "KVCacheState",
+    "PagedKVHandle",
+    "PagedKVPool",
+    "RadixPrefixIndex",
     "ServingConfig",
     "SessionState",
+    "SessionTierManager",
+    "SpilledKVBlock",
     "TokenBudgetScheduler",
     "TokenEvent",
     "continuous_batching",

@@ -50,11 +50,13 @@ from flink_tensorflow_tpu.serving.kv_cache import (
     KVCacheState,
     SessionState,
 )
+from flink_tensorflow_tpu.serving.paged import PagedKVHandle
 from flink_tensorflow_tpu.serving.records import GenerateRequest, TokenEvent
 from flink_tensorflow_tpu.serving.scheduler import (
     ServingConfig,
     TokenBudgetScheduler,
 )
+from flink_tensorflow_tpu.serving.tiering import SpilledKVBlock
 
 if typing.TYPE_CHECKING:
     from flink_tensorflow_tpu.models.base import Model
@@ -119,6 +121,8 @@ class ContinuousBatchingOperator(Operator):
         self.key_selector = key_selector
         self._sched: typing.Optional[TokenBudgetScheduler] = None
         self._runner = None
+        self._paged = False
+        self._tier = None
         self._cache: typing.Optional[KVCacheState] = None
         self._sessions: typing.Dict[typing.Any, _Session] = {}
         self._seq = 0
@@ -128,7 +132,10 @@ class ContinuousBatchingOperator(Operator):
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> None:
-        from flink_tensorflow_tpu.functions.runner import DecodeStepRunner
+        from flink_tensorflow_tpu.functions.runner import (
+            DecodeStepRunner,
+            PagedDecodeStepRunner,
+        )
 
         cfg = self.serving_config
         model_cap = (self.model.metadata.get("config") or {}).get("capacity")
@@ -140,14 +147,40 @@ class ContinuousBatchingOperator(Operator):
             )
         self._sched = TokenBudgetScheduler(cfg)
         self._cache = KVCacheState(self.keyed_state)
-        self._runner = DecodeStepRunner(
-            self.model,
-            pool_slots=cfg.max_active_seqs,
-            capacity=cfg.capacity,
-            padding_buckets=cfg.padding_buckets,
-            prompt_buckets=cfg.resolved_prompt_buckets(),
-            device=self.ctx.device if self.ctx else None,
-        )
+        self._paged = cfg.paged_kv
+        if self._paged:
+            from flink_tensorflow_tpu.serving.tiering import (
+                SessionTierManager,
+            )
+
+            self._runner = PagedDecodeStepRunner(
+                self.model,
+                pool_slots=cfg.max_active_seqs,
+                capacity=cfg.capacity,
+                page_tokens=cfg.page_tokens,
+                num_pages=cfg.resolved_hbm_pages(),
+                prefix_sharing=cfg.prefix_sharing,
+                padding_buckets=cfg.padding_buckets,
+                prompt_buckets=cfg.resolved_prompt_buckets(),
+                device=self.ctx.device if self.ctx else None,
+            )
+            self._tier = SessionTierManager(
+                spill_dir=cfg.spill_dir,
+                host_cache_sessions=cfg.host_cache_sessions,
+                high_watermark=cfg.tier_high_watermark,
+                low_watermark=cfg.tier_low_watermark,
+                subtask_index=self.ctx.subtask_index if self.ctx else 0,
+            )
+        else:
+            self._runner = DecodeStepRunner(
+                self.model,
+                pool_slots=cfg.max_active_seqs,
+                capacity=cfg.capacity,
+                padding_buckets=cfg.padding_buckets,
+                prompt_buckets=cfg.resolved_prompt_buckets(),
+                device=self.ctx.device if self.ctx else None,
+            )
+            self._tier = None
         self._runner.open(self.ctx)
         if cfg.warmup_compile:
             self._runner.warmup(cfg.resolved_admit_buckets(),
@@ -171,6 +204,28 @@ class ContinuousBatchingOperator(Operator):
             grp.gauge("cache_d2h_blocks", lambda r=runner: r.block_d2h_events)
             grp.gauge("cache_resident_moves",
                       lambda r=runner: r.device_block_moves)
+            if self._paged:
+                pool = self._runner.pool
+                tier = self._tier
+                grp.gauge("kv_pages_total", lambda p=pool: p.num_pages)
+                grp.gauge("kv_pages_free", lambda p=pool: p.free_pages)
+                # Percent, not fraction: SLO rule thresholds read better
+                # as 85/95 than 0.85/0.95 in the rule table.
+                grp.gauge("kv_page_occupancy_pct",
+                          lambda p=pool: 100.0 * p.occupancy_frac())
+                grp.gauge("kv_pages_shared", lambda p=pool: p.pages_shared)
+                grp.gauge("kv_cow_splits", lambda p=pool: p.cow_splits)
+                if self._runner.index is not None:
+                    idx = self._runner.index
+                    grp.gauge("kv_indexed_pages",
+                              lambda i=idx: i.indexed_pages)
+                grp.gauge("kv_demoted_sessions", lambda t=tier: t.demoted)
+                grp.gauge("kv_spilled_sessions", lambda t=tier: t.spilled)
+                grp.gauge("kv_revived_warm", lambda t=tier: t.revived_warm)
+                grp.gauge("kv_revived_cold", lambda t=tier: t.revived_cold)
+                # Demote/spill/revive churn — the kv-tier-thrash rate
+                # rule's input.
+                grp.gauge("kv_tier_moves", lambda t=tier: t.tier_moves)
             # Time-to-first-token: request admission -> first generated
             # token emitted.  The health plane's serving-ttft rule reads
             # this histogram's p95 off the merged cohort snapshot.
@@ -188,6 +243,10 @@ class ContinuousBatchingOperator(Operator):
             if sess.status == DONE:
                 continue
             sess.status = WAITING
+            if self._tier is not None and isinstance(sess.kv, KVBlock):
+                # Restored blocks land on the warm rung: host-resident
+                # until re-admission (spilled stubs stay cold on disk).
+                self._tier.note_warm(key)
             pending.append((sess.seq, key))
         for _, key in sorted(pending):
             sess = self._sessions[key]
@@ -287,28 +346,141 @@ class ContinuousBatchingOperator(Operator):
             return True
         return sess.eos is not None and tok == sess.eos
 
+    def _finish_session(self, key, slot: int, sess: _Session) -> None:
+        """A session generated its last token: publish + free its pages
+        (paged) and release the scheduler slot."""
+        sess.status = DONE
+        if self._paged:
+            # Cache-valid tokens: the final generated token was never
+            # fed back, so the pages hold prompt + generated[:-1].
+            cached = list(int(t) for t in sess.prompt) + [
+                int(t) for t in sess.generated[:-1]]
+            self._runner.release_finished(slot, cached,
+                                          self._sched.lengths[key])
+            self._tier.note_gone(key)
+        self._sched.release(key, reason="finished")
+
+    # -- paged tier machinery ---------------------------------------------
+    def _demote_parked(self, key) -> None:
+        """Hot -> warm: a parked session's pages gather d2h and free."""
+        sess = self._sessions[key]
+        sess.kv = self._runner.demote_handle(sess.kv)
+        self._tier.demoted += 1
+        self._tier.note_warm(key)
+
+    def _preempt_to_host(self, key) -> None:
+        """Pressure preemption of an ACTIVE session straight to the
+        warm tier (its pages are the ransom)."""
+        sched = self._sched
+        slot = sched.slot_of(key)
+        length = sched.lengths[key]
+        k, v = self._runner.extract_host(slot, length)
+        sess = self._sessions[key]
+        sess.kv = KVBlock(k, v, length)
+        sess.status = WAITING
+        sched.preempt(key)
+        self._tier.demoted += 1
+        self._tier.note_warm(key)
+
+    def _paged_make_room(self, pages_needed: int, *, protect=None,
+                         preempt: bool = True) -> bool:
+        """Free pages for an allocation the pool couldn't satisfy:
+        demote parked hot sessions LRU-first, then (last resort, and
+        never during admission — a just-admitted session has no block
+        table to extract yet) preempt the newest active sessions to the
+        warm tier."""
+        pool = self._runner.pool
+        # The generator re-checks live occupancy after every demotion —
+        # iterate it directly (list() would spin on the first key).
+        for key in self._tier.demotions(
+                pool.occupancy_frac, force_pages=pages_needed,
+                free_pages=lambda: pool.free_pages):
+            self._demote_parked(key)
+        if pool.free_pages >= pages_needed:
+            return True
+        if preempt:
+            for key in reversed(list(self._sched.active)):
+                if key == protect:
+                    continue
+                self._preempt_to_host(key)
+                if pool.free_pages >= pages_needed:
+                    return True
+        return pool.free_pages >= pages_needed
+
+    def _tier_sweep(self) -> None:
+        """End-of-step watermark pass: parked sessions demote above the
+        high watermark (draining to the low one), and the warm rung
+        spills its overflow to disk."""
+        if not self.serving_config.tiering:
+            return
+        pool = self._runner.pool
+        for key in self._tier.demotions(pool.occupancy_frac):
+            self._demote_parked(key)
+        for key in self._tier.overflow_spills():
+            sess = self._sessions[key]
+            sess.kv = self._tier.spill(key, sess.kv)
+
     def _serving_step(self) -> None:
         sched = self._sched
         cfg = self.serving_config
         sessions = self._sessions
         sched.counters.steps += 1
 
-        # 1) Admission under max_active_seqs + token budget.
+        # 1) Admission under max_active_seqs + token budget (+ the paged
+        # pool's page-availability gate).
         def length_of(key):
             sess = sessions[key]
             return (sess.kv.length if sess.kv is not None
                     else len(sess.prompt))
 
-        admitted = sched.plan_admissions(length_of)
+        admit_gate = None
+        if self._paged:
+            pool = self._runner.pool
+            runner = self._runner
+            reserved = [0]
+
+            def admit_gate(key, length):
+                sess = sessions[key]
+                if isinstance(sess.kv, PagedKVHandle):
+                    return True  # hot: pages already held in HBM
+                need = pool.pages_for(length + 1)
+                # Evictable = free + index-only pages: the runner's
+                # allocator evicts the prefix index lazily, so counting
+                # only the free list would wedge admission behind a
+                # fully-indexed pool.
+                if runner.free_pages_evictable() - reserved[0] < need:
+                    self._paged_make_room(need + reserved[0],
+                                          preempt=False)
+                if runner.free_pages_evictable() - reserved[0] < need:
+                    return False
+                reserved[0] += need
+                return True
+
+        admitted = sched.plan_admissions(length_of, admit_gate)
         fresh: typing.List[typing.Tuple[typing.Any, int, _Session]] = []
         for key, slot in admitted:
             sess = sessions[key]
             sess.status = ACTIVE
             if sess.kv is not None:
-                # Resume: the checkpointed/preempted block re-enters the
-                # pool — h2d iff host-resident, device-side otherwise.
+                # Resume: the checkpointed/preempted/tiered cache
+                # re-enters the pool — zero traffic for hot pages, one
+                # h2d for a warm block, disk read + h2d for a cold one.
                 # (plan_admissions already booked kv.length tokens.)
-                self._runner.insert_block(slot, sess.kv.k, sess.kv.v)
+                if self._paged:
+                    kv, tier_from = sess.kv, None
+                    if isinstance(kv, SpilledKVBlock):
+                        kv = self._tier.revive(kv)
+                        tier_from = "cold"
+                    elif isinstance(kv, KVBlock):
+                        tier_from = "warm"
+                    if isinstance(kv, PagedKVHandle):
+                        self._runner.attach(slot, kv)
+                    else:
+                        self._runner.insert_block(slot, kv.k, kv.v,
+                                                  length=kv.length)
+                    self._tier.note_admitted(key, tier=tier_from)
+                else:
+                    self._runner.insert_block(slot, sess.kv.k, sess.kv.v)
                 sess.kv = None
             else:
                 fresh.append((key, slot, sess))
@@ -326,10 +498,25 @@ class ContinuousBatchingOperator(Operator):
                 ends = self._ends(sess, tok)
                 self._append_token(key, sess, tok, ends)
                 if ends:
-                    sess.status = DONE
-                    sched.release(key, reason="finished")
+                    self._finish_session(key, slot, sess)
 
-        # 3) One decode step over the whole active set.
+        # 3) One decode step over the whole active set.  Paged: the
+        # write position must land in an exclusively owned page first —
+        # page-boundary growth allocates, shared bytes copy-on-write
+        # split, and a dry pool demotes parked sessions (or, last
+        # resort, preempts the newest active) until the write can land.
+        if self._paged:
+            for key in list(sched.active):
+                slot = sched.active.get(key)
+                if slot is None:
+                    continue  # preempted by a make_room below
+                while not self._runner.ensure_writable(
+                        slot, sched.lengths[key]):
+                    if not self._paged_make_room(1, protect=key):
+                        raise RuntimeError(
+                            f"{self.name}: cannot free a single KV page "
+                            f"for session {key!r} — pool of "
+                            f"{self._runner.num_pages} pages is pinned")
         if sched.active:
             slots = self._runner.pool_slots
             tokens = [0] * slots
@@ -349,23 +536,31 @@ class ContinuousBatchingOperator(Operator):
                 ends = self._ends(sess, tok)
                 self._append_token(key, sess, tok, ends)
                 if ends:
-                    sess.status = DONE
-                    sched.release(key, reason="finished")
+                    self._finish_session(key, slot, sess)
 
         # 4) Budget enforcement: preempt the newest sessions; their cache
-        # follows them into keyed state (device-resident when configured
-        # — zero host traffic — host KVBlock otherwise).
+        # follows them into keyed state.  Paged sessions PARK — pages
+        # stay hot in HBM, the tier sweep decides if they demote; dense
+        # blocks move device-resident or to host per config.
         for key in sched.over_budget():
             slot = sched.slot_of(key)
             length = sched.lengths[key]
-            k, v = self._runner.extract_block(
-                slot, length, host=not cfg.device_resident_blocks)
             sess = sessions[key]
-            sess.kv = (DeviceKVBlock(k, v, length)
-                       if cfg.device_resident_blocks
-                       else KVBlock(k, v, length))
+            if self._paged:
+                sess.kv = self._runner.park(slot, length)
+                self._tier.note_parked(key)
+            else:
+                k, v = self._runner.extract_block(
+                    slot, length, host=not cfg.device_resident_blocks)
+                sess.kv = (DeviceKVBlock(k, v, length)
+                           if cfg.device_resident_blocks
+                           else KVBlock(k, v, length))
             sess.status = WAITING
             sched.preempt(key)
+
+        # 5) Tier ladder: watermark demotions + warm-rung disk spill.
+        if self._paged:
+            self._tier_sweep()
 
     # -- snapshot hooks ----------------------------------------------------
     def _function_snapshot(self, checkpoint_id=None):
@@ -381,7 +576,11 @@ class ContinuousBatchingOperator(Operator):
             if sess.status == ACTIVE:
                 slot = sched.active[key]
                 length = sched.lengths[key]
-                k, v = self._runner.extract_block(slot, length, host=True)
+                if self._paged:
+                    k, v = self._runner.snapshot_block(slot, length)
+                else:
+                    k, v = self._runner.extract_block(slot, length,
+                                                      host=True)
                 # The pool stays authoritative; the frozen copy (with
                 # the host block attached) is the restore point.
                 cache.put(key, dataclasses.replace(
@@ -389,6 +588,11 @@ class ContinuousBatchingOperator(Operator):
             else:
                 if isinstance(sess.kv, DeviceKVBlock):
                     sess.kv = sess.kv.to_host()
+                elif isinstance(sess.kv, PagedKVHandle):
+                    # Hot-parked pages cannot cross a pickle boundary —
+                    # the barrier demotes them to a host block (the
+                    # paged analogue of the DeviceKVBlock downgrade).
+                    self._demote_parked(key)
                 cache.put(key, sess.freeze())
         if self._grp is not None:
             self._grp.histogram("cache_sync_s").record(

@@ -65,6 +65,41 @@ class ServingConfig:
     #: active set is empty or the backlog is shallower than the
     #: threshold, so light-load time-to-first-token is untouched.
     admit_hysteresis: int = 1
+    #: Paged KV economy (serving/paged.py + serving/tiering.py): the
+    #: cache pool becomes ``hbm_pages`` fixed-size pages of
+    #: ``page_tokens`` positions with a per-session block table —
+    #: admission needs free PAGES, not a contiguous slot — plus a
+    #: radix-tree prefix index (sessions sharing a prompt prefix share
+    #: pages, copy-on-write at divergence) and the HBM->host->disk
+    #: residency ladder.  Off (the default) keeps the dense
+    #: ``[S, L, C, H, Dh]`` pool exactly as before.
+    paged_kv: bool = False
+    page_tokens: int = 16
+    #: HBM page budget.  ``None`` sizes the pool to the dense
+    #: equivalent (``max_active_seqs * capacity / page_tokens``); the
+    #: oversubscription benches size it far SMALLER than the live
+    #: session population and let tiering absorb the difference.
+    hbm_pages: typing.Optional[int] = None
+    prefix_sharing: bool = True
+    #: The residency ladder's watermark sweep: parked (preempted-hot)
+    #: sessions demote to host blocks when pool occupancy crosses the
+    #: high watermark, draining to the low one; the warm rung spills to
+    #: ``spill_dir`` past ``host_cache_sessions``.  ``tiering=False``
+    #: keeps only pressure-forced demotion (an allocation that cannot
+    #: be satisfied any other way) — the ``kv-pool-pressure`` SLO rule
+    #: is how that misconfiguration surfaces.
+    tiering: bool = True
+    tier_high_watermark: float = 0.90
+    tier_low_watermark: float = 0.70
+    host_cache_sessions: int = 64
+    #: Cold rung directory; ``None`` disables disk spill (warm blocks
+    #: then accumulate on the host without bound).
+    spill_dir: typing.Optional[str] = None
+
+    def resolved_hbm_pages(self) -> int:
+        if self.hbm_pages is not None:
+            return self.hbm_pages
+        return self.max_active_seqs * (self.capacity // self.page_tokens)
 
     def resolved_prompt_buckets(self) -> typing.Tuple[int, ...]:
         return self.prompt_buckets or _pow2_buckets(self.capacity)
@@ -148,14 +183,19 @@ class TokenBudgetScheduler:
             self.waiting.append(key)
 
     def plan_admissions(
-        self, length_of: typing.Callable[[typing.Any], int]
+        self, length_of: typing.Callable[[typing.Any], int],
+        admit_gate: typing.Optional[
+            typing.Callable[[typing.Any, int], bool]] = None,
     ) -> typing.List[typing.Tuple[typing.Any, int]]:
         """Pop admissible sessions off the waiting queue: returns
         ``[(key, slot)]`` in arrival order.  ``length_of(key)`` is the
         cache length the session will occupy at admission (prompt length
         for fresh sessions, the preserved block length for resumed
         ones).  Budget charges length + 1 — the step it's admitted into
-        grows it immediately."""
+        grows it immediately.  ``admit_gate(key, length)`` is the paged
+        pool's page-availability check (free pages instead of a
+        contiguous slot); a False stops admission FIFO-fairly — nobody
+        jumps the queue past a session the pool can't seat yet."""
         out: typing.List[typing.Tuple[typing.Any, int]] = []
         hyst = self.config.admit_hysteresis
         if (hyst > 1 and self.active
@@ -167,6 +207,8 @@ class TokenBudgetScheduler:
             need = length_of(key) + 1
             if self.tokens_in_use + need > self.config.token_budget and self.active:
                 break  # budget-full (never starves: an empty active set admits)
+            if admit_gate is not None and not admit_gate(key, need - 1):
+                break  # no pages free — tier pressure clears first
             self.waiting.popleft()
             slot = self.free_slots.pop()
             self.active[key] = slot

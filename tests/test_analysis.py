@@ -547,6 +547,75 @@ class TestServingLints:
         assert len(diags) == 1 and diags[0].node == "fixed"
 
 
+class TestKvPoolUndersizedLint:
+    """kv-pool-undersized: an open-loop paced source offering sessions
+    faster than the ``max_active_seqs``-bounded admission plane can
+    possibly turn over, against a serving config with no KV tier valve
+    (dense plane, or paged with tiering off).  ISSUE 19 matrix."""
+
+    def _env(self, config, *, rate_hz=100.0, paced=True):
+        from flink_tensorflow_tpu.serving import continuous_batching
+        from flink_tensorflow_tpu.sources import PacedSplitSource
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        reqs = TestServingLints._requests()
+        if paced:
+            stream = env.from_source(
+                PacedSplitSource(reqs, rate_hz), name="paced")
+        else:
+            stream = env.from_collection(reqs)
+        continuous_batching(
+            stream.key_by(lambda r: r.session_id),
+            TestServingLints._model(), config=config,
+        ).sink_to_list()
+        return env
+
+    def test_open_loop_overrate_dense_warns(self):
+        from flink_tensorflow_tpu.serving import ServingConfig
+
+        env = self._env(ServingConfig(capacity=32, max_active_seqs=4))
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "kv-pool-undersized")
+        assert len(diags) == 1 and diags[0].severity == Severity.WARN
+        assert "paged_kv" in diags[0].message
+        assert "4 admission slots" in diags[0].message
+
+    def test_paged_with_tiering_off_still_warns(self):
+        from flink_tensorflow_tpu.serving import ServingConfig
+
+        env = self._env(ServingConfig(
+            capacity=32, max_active_seqs=4, paged_kv=True, page_tokens=8,
+            tiering=False))
+        diags = by_rule(analyze(env.graph, config=env.config),
+                        "kv-pool-undersized")
+        assert len(diags) == 1
+        assert "tiering" in diags[0].message
+
+    def test_paged_tiered_plan_is_silent(self):
+        from flink_tensorflow_tpu.serving import ServingConfig
+
+        env = self._env(ServingConfig(
+            capacity=32, max_active_seqs=4, paged_kv=True, page_tokens=8))
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "kv-pool-undersized") == []
+
+    def test_rate_within_admission_bound_is_silent(self):
+        from flink_tensorflow_tpu.serving import ServingConfig
+
+        env = self._env(ServingConfig(capacity=32, max_active_seqs=4),
+                        rate_hz=2.0)
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "kv-pool-undersized") == []
+
+    def test_closed_loop_source_is_silent(self):
+        from flink_tensorflow_tpu.serving import ServingConfig
+
+        env = self._env(ServingConfig(capacity=32, max_active_seqs=4),
+                        paced=False)
+        assert by_rule(analyze(env.graph, config=env.config),
+                       "kv-pool-undersized") == []
+
+
 class TestWatermarkLints:
     """ISSUE-2 satellite: the deferred watermark lints from ROADMAP."""
 

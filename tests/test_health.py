@@ -228,6 +228,66 @@ class TestCreditStarvationRule:
 
 
 # ---------------------------------------------------------------------------
+# paged KV economy SLOs (ISSUE 19): pool pressure + tier thrash
+# ---------------------------------------------------------------------------
+
+
+class TestKvEconomyRules:
+    @staticmethod
+    def _rule(rule_id):
+        return {r.id: r for r in default_rules()}[rule_id]
+
+    def test_catalogue_carries_both_kv_rules(self):
+        by_id = {r.id: r for r in default_rules()}
+        assert by_id["kv-pool-pressure"].action == "scale_up"
+        assert by_id["kv-tier-thrash"].mode == "rate"
+
+    def test_pool_pressure_breaches_on_sustained_occupancy(self):
+        ev = HealthEvaluator([self._rule("kv-pool-pressure")])
+        fired = []
+        for i, pct in enumerate([96.0, 97.0]):
+            fired.extend(ev.evaluate_once(
+                {"serve.0": {"kv_page_occupancy_pct": pct}}, now=100.0 + i))
+        assert [(t.old, t.new) for t in fired] == [(OK, BREACH)]
+        assert fired[0].action == "scale_up"
+
+    def test_pool_pressure_warn_band(self):
+        ev = HealthEvaluator([self._rule("kv-pool-pressure")])
+        fired = []
+        for i in range(4):
+            fired.extend(ev.evaluate_once(
+                {"serve.0": {"kv_page_occupancy_pct": 88.0}}, now=100.0 + i))
+        assert [(t.old, t.new) for t in fired] == [(OK, WARN)]
+
+    def test_tier_thrash_rates_the_cumulative_move_counter(self):
+        ev = HealthEvaluator([self._rule("kv-tier-thrash")])
+        fired = []
+        # 60 demote/revive transitions per second, sustained: thrash.
+        for i, raw in enumerate([0.0, 60.0, 120.0, 180.0]):
+            fired.extend(ev.evaluate_once(
+                {"serve.0": {"kv_tier_moves": raw}}, now=100.0 + i))
+        assert [(t.old, t.new) for t in fired] == [(OK, BREACH)]
+        assert fired[0].value == pytest.approx(60.0)
+
+    def test_slow_tier_movement_stays_ok(self):
+        ev = HealthEvaluator([self._rule("kv-tier-thrash")])
+        fired = []
+        for i, raw in enumerate([0.0, 2.0, 4.0, 6.0]):
+            fired.extend(ev.evaluate_once(
+                {"serve.0": {"kv_tier_moves": raw}}, now=100.0 + i))
+        assert fired == []
+
+    def test_dense_plan_without_kv_metrics_never_fires(self):
+        ev = HealthEvaluator([self._rule("kv-pool-pressure"),
+                              self._rule("kv-tier-thrash")])
+        fired = []
+        for i in range(3):
+            fired.extend(ev.evaluate_once(
+                {"serve.0": {"active_seqs": 4.0}}, now=100.0 + i))
+        assert fired == []
+
+
+# ---------------------------------------------------------------------------
 # evaluator publication: gauges, flight, rollups
 # ---------------------------------------------------------------------------
 

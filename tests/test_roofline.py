@@ -574,3 +574,172 @@ class TestOverheadGuard:
         assert handle.executor.roofline is None
         assert not any("roofline" in k
                        for k in env.metric_registry.report())
+
+
+# ---------------------------------------------------------------------------
+# cache tier moves (ISSUE 19 satellite): priced, attributed, no compiles
+# ---------------------------------------------------------------------------
+
+
+def make_cache_table():
+    """A table pricing tier moves only — one paged and one dense row
+    (the byte values are 2 * L * tokens * H * Dh * 4 for the shared
+    char_transformer geometry: page_tokens=8 -> 4096 B/page)."""
+    return CostTable(ops=[OperatorCost(
+        node="continuous_batching", kind="serving",
+        entries=[
+            CostEntry(unit="cache_move", signature="cache:pages:2",
+                      h2d_bytes=8192, d2h_bytes=8192),
+            CostEntry(unit="cache_move", signature="cache:block",
+                      h2d_bytes=20480, d2h_bytes=20480),
+        ])])
+
+
+class TestCacheMoveAttribution:
+    """observe_transfer closes the PR-17 "non-runner h2d attribution"
+    deferral: tier moves accrue busy time and drift pairs, but they are
+    data motion, not executables — no compile event, no first-sight
+    suppression."""
+
+    def test_no_compile_event_and_first_call_counts(self):
+        probe = make_probe(table=make_cache_table())
+        probe.observe_transfer("cache_move", 0.01,
+                               signature="cache:pages:2", d2h_bytes=8192)
+        # The FIRST spill pays the same wire time as the hundredth:
+        # counted immediately, and never logged as a jit cache miss.
+        assert probe.compile_events == 0
+        assert probe.busy_s == pytest.approx(0.01)
+        assert probe.h2d_paired_calls == 1
+        assert probe.h2d_drift_frac() == 0.0
+
+    def test_warmup_suppresses_transfers(self):
+        probe = make_probe(table=make_cache_table())
+        probe.begin_warmup()
+        probe.observe_transfer("cache_move", 0.5,
+                               signature="cache:block", h2d_bytes=20480)
+        probe.end_warmup()
+        assert probe.busy_s == 0.0 and probe.h2d_bytes == 0
+
+    def test_inflated_transfer_raises_drift_finding(self):
+        grp = FakeGroup()
+        probe = make_probe(metrics=grp, table=make_cache_table())
+        for _ in range(3):
+            # A revival moving 2x the priced bytes (e.g. an fp32 spill
+            # of a cache the plan priced at bf16).
+            probe.observe_transfer("cache_move", 0.01,
+                                   signature="cache:pages:2",
+                                   h2d_bytes=16384)
+        assert probe.h2d_drift_frac() == pytest.approx(1.0)
+        report = roofline_report({"continuous_batching.0": grp.read()},
+                                 device="cpu-test")
+        drift = [f for f in report["findings"]
+                 if f["rule"] == "roofline-drift"]
+        assert len(drift) == 1
+        assert drift[0]["measured_h2d_per_call"] == pytest.approx(16384.0)
+        assert drift[0]["predicted_h2d_per_call"] == pytest.approx(8192.0)
+
+    def test_transfer_only_probe_ranks_wire_bound(self):
+        probe = make_probe(table=make_cache_table())
+        for _ in range(3):
+            probe.observe_transfer("cache_move", 0.5,
+                                   signature="cache:pages:2",
+                                   d2h_bytes=8192)
+        # No compute entry ever joined (flops == hbm == 0) — pure cache
+        # churn still classifies instead of dropping to "none".
+        assert probe.flops == 0 and probe.hbm_bytes == 0
+        assert probe.bound() == BOUND_WIRE
+
+    def test_rows_from_trace_joins_cache_spans(self):
+        spec = DEVICE_SPECS["cpu-test"]
+        events = [
+            # A paged demotion (d2h) and a dense warm-tier insert (h2d),
+            # exactly as the runners emit them.
+            ("continuous_batching.0", "cache.d2h", "X", 0.0, 0.1,
+             {"pages": 2, "bytes": 8192}),
+            ("continuous_batching.0", "cache.h2d", "X", 0.2, 0.1,
+             {"slot": 0, "bytes": 20480}),
+            ("continuous_batching.0", "queue", "X", 0.0, 0.2, {}),
+        ]
+        rows = rows_from_trace(events, make_cache_table(), spec)
+        (row,) = rows
+        assert row["busy_s"] == pytest.approx(0.2)
+        assert row["measured_h2d_per_call"] == pytest.approx(
+            (8192 + 20480) / 2)
+        assert row["predicted_h2d_per_call"] == pytest.approx(
+            (8192 + 20480) / 2)
+        assert row["h2d_drift_frac"] == 0.0
+
+    def test_paged_plan_prices_pages_tables_and_moves(self, model):
+        from flink_tensorflow_tpu.analysis.costmodel import (
+            cost_table_for_env,
+        )
+
+        env = StreamExecutionEnvironment(parallelism=1)
+        serving.continuous_batching(
+            env.from_collection(make_requests(6)).key_by(
+                lambda r: r.session_id),
+            model,
+            config=serving.ServingConfig(
+                max_active_seqs=4, token_budget=256, capacity=40,
+                paged_kv=True, page_tokens=8),
+            parallelism=1,
+        ).sink_to_list()
+        table = cost_table_for_env(env)
+        (oc,) = [o for o in table.ops if o.kind == "serving"]
+        assert not oc.notes
+        # Paged decode h2d: tokens + lengths + the [S, C/pt] block
+        # tables (no dense bool mask — liveness rides the sentinel).
+        step = oc.entry("decode_step")
+        assert step.h2d_bytes == 4 * 4 + 4 * 4 + 4 * 5 * 4
+        assert step.flops > 0
+        # Prefill rides the [b, C/pt] scatter table instead of the [b]
+        # slot vector.
+        pre = oc.entry("prefill", serving_signature("prefill", 4, 8))
+        assert pre.h2d_bytes == 4 * 8 * 4 + 4 * 4 + 4 * 5 * 4
+        # One cache_move entry per possible page count, priced at
+        # 2 (K+V) * L * page_tokens * H * Dh * itemsize each way.
+        moves = [e for e in oc.entries if e.unit == "cache_move"]
+        assert [e.signature for e in moves] == [
+            f"cache:pages:{n}" for n in range(1, 6)]
+        page_bytes = 2 * 2 * 8 * 2 * 16 * 4
+        assert all(e.h2d_bytes == e.d2h_bytes == (i + 1) * page_bytes
+                   for i, e in enumerate(moves))
+        # Transfers are not executables: never in the compile ladder.
+        assert not any(s.startswith("cache")
+                       for s in oc.predicted_signatures)
+
+    def test_tiered_run_attributes_transfers_live(self, model, tmp_path):
+        """End-to-end: an oversubscribed paged run with tiering forces
+        demote/revive traffic; the probe must absorb it with zero
+        unpredicted compiles, non-zero measured transfer bytes, and no
+        drift (the cache_move prices match the real page geometry)."""
+        rng = np.random.RandomState(7)
+        reqs = [serving.GenerateRequest(
+            session_id=f"s{i}",
+            prompt=rng.randint(1, 48, (int(rng.randint(4, 10)),)),
+            max_new_tokens=8) for i in range(24)]
+        env = StreamExecutionEnvironment(parallelism=1)
+        env.configure(roofline=RooflineConfig(device="cpu-test"))
+        serving.continuous_batching(
+            env.from_collection(reqs).key_by(lambda r: r.session_id),
+            model,
+            config=serving.ServingConfig(
+                max_active_seqs=4, token_budget=40, capacity=40,
+                paged_kv=True, page_tokens=8, hbm_pages=9,
+                prefix_sharing=False,
+                tier_high_watermark=0.6, tier_low_watermark=0.3,
+                host_cache_sessions=0, spill_dir=str(tmp_path)),
+            parallelism=1,
+        ).sink_to_list()
+        handle = env.execute_async("roofline-kveconomy")
+        handle.wait(120)
+        m = env.metric_registry.report()
+        assert m["continuous_batching.0.kv_tier_moves"] >= 2
+        report = roofline_report(env.metric_registry.snapshot(),
+                                 device="cpu-test")
+        row = [r for r in report["rows"]
+               if r["operator"] == "continuous_batching.0"][0]
+        assert row["unpredicted_compiles"] == 0
+        assert row["measured_h2d_per_call"] > 0
+        # Demote d2h and revive h2d both priced exactly: no drift.
+        assert row["h2d_drift_frac"] == 0.0

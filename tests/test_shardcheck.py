@@ -405,3 +405,47 @@ class TestHealthy:
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+# ---------------------------------------------------------------------------
+# Paged KV economy (ISSUE 19): the audit prices the page pool and the
+# block-table h2d, not the dense seats x capacity layout.
+# ---------------------------------------------------------------------------
+class TestPagedKvAudit:
+    def _serving_op(self, **kw):
+        from flink_tensorflow_tpu import serving
+        from flink_tensorflow_tpu.analysis import report_for_env
+
+        model = _zoo_decoder()
+        cfg = serving.ServingConfig(max_active_seqs=2, capacity=16,
+                                    token_budget=32, **kw)
+
+        def build(env):
+            serving.continuous_batching(
+                env.from_collection([{}]).key_by(lambda r: 0),
+                model, config=cfg, name="serve_llm", parallelism=1)
+        report = report_for_env(_plan(build))
+        (op,) = [o for o in report["operators"] if o["kind"] == "serving"]
+        return op
+
+    def test_paged_pool_budget_is_page_count_not_seats(self):
+        dense = self._serving_op()
+        paged = self._serving_op(paged_kv=True, page_tokens=8, hbm_pages=3)
+        # 2 (K+V) * L * page_tokens * H * Dh * itemsize, zoo decoder
+        # geometry: 1 layer, 2 heads, Dh=8, fp32.
+        page_bytes = 2 * 1 * 8 * 2 * 8 * 4
+        assert paged["hbm_per_device_bytes"]["kv_pool"] == 3 * page_bytes
+        # The dense audit prices seats x capacity (= 4 pages worth) —
+        # an undersized paged pool audits SMALLER than the dense pool;
+        # the overflow is the host/disk tiers' problem, not HBM's.
+        assert (dense["hbm_per_device_bytes"]["kv_pool"]
+                == 2 * 2 * page_bytes)
+        assert not paged["notes"], paged["notes"]
+
+    def test_paged_step_h2d_rides_block_tables(self):
+        dense = self._serving_op()
+        paged = self._serving_op(paged_kv=True, page_tokens=8, hbm_pages=4)
+        # Paged: [S] tokens + [S] lengths + [S, C/pt] int32 block
+        # tables (no bool mask — liveness rides the sentinel page id).
+        assert paged["predicted_step_h2d_bytes"] == 2 * 4 + 2 * 4 + 2 * 2 * 4
+        assert dense["predicted_step_h2d_bytes"] == 2 * 4 + 2 * 4 + 2 * 1
