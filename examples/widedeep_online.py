@@ -71,7 +71,15 @@ def main(argv=None):
         env.from_collection(records, parallelism=1, schema=schema)
         .key_by(lambda r: r.meta["user"])
         .process(
+            # State declared explicitly: the TrainState (params +
+            # optimizer moments) lives in subtask-scoped OPERATOR state
+            # — snapshot_state()/restore_state() round-trip it through
+            # checkpoint barriers, and per-step RNG derives via
+            # jax.random.fold_in from the seeded key.  flink-tpu-
+            # statecheck audits exactly this: nothing model-shaped may
+            # hide in closures, globals, or undeclared instance attrs.
             OnlineTrainFunction(mdef, optax.adam(1e-2), train_schema=schema,
+                                scope="subtask", seed=0,
                                 mini_batch=args.batch,
                                 # Fuse 8 SGD steps into one lax.scan
                                 # dispatch: on remote-attached chips the

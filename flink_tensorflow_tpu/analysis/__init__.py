@@ -51,6 +51,13 @@ from flink_tensorflow_tpu.analysis.shardcheck import (
     audit_plan,
     report_for_env,
 )
+from flink_tensorflow_tpu.analysis.statecheck import (
+    OpStateAudit,
+    PlanStateAudit,
+    audit_of as statecheck_audit_of,
+    audit_plan as statecheck_audit_plan,
+    report_for_env as statecheck_report_for_env,
+)
 
 __all__ = [
     "RULES",
@@ -59,8 +66,10 @@ __all__ = [
     "Diagnostic",
     "LintRule",
     "OpAudit",
+    "OpStateAudit",
     "PlanAudit",
     "PlanCaptured",
+    "PlanStateAudit",
     "PlanValidationError",
     "PurityFinding",
     "SchemaFlow",
@@ -85,5 +94,8 @@ __all__ = [
     "scan_operator",
     "sharding_axes_of",
     "sharding_fusion_conflict",
+    "statecheck_audit_of",
+    "statecheck_audit_plan",
+    "statecheck_report_for_env",
     "worst_severity",
 ]

@@ -720,44 +720,12 @@ def _flow_control_disabled(ctx: AnalysisContext, emit: Emit) -> None:
             )
 
 
-@rule("exactly-once-boundary", Severity.WARN)
-def _exactly_once_boundary(ctx: AnalysisContext, emit: Emit) -> None:
-    """Checkpointed plan ingesting through a NON-REPLAYABLE source: a
-    raw ``RemoteSource`` (or any source declaring ``replayable =
-    False``) is a live TCP stream — restart-from-checkpoint rewinds
-    every operator's state to the snapshot and replays sources from
-    their recorded offsets, but a network stream cannot be re-read, so
-    records consumed after the restored checkpoint are processed
-    at-least-once ... or lost outright if they were in flight
-    (documented in io/remote.py).  The exactly-once story stops at this
-    boundary no matter how transactional the sinks are.  Front the feed
-    with a durable write-ahead log — land it in frame files and ingest
-    via a replayable ``FileSplitSource`` — exactly as Flink treats raw
-    socket sources."""
-    cfg = ctx.config
-    if cfg is None:
-        return
-    checkpoint = getattr(cfg, "checkpoint", None)
-    if checkpoint is None or getattr(checkpoint, "dir", None) is None:
-        return  # no checkpoint/restart story claimed — nothing to break
-    for t in ctx.order:
-        if not t.is_source:
-            continue
-        op = ctx.operators.get(t.id)
-        for attr in ("function", "source"):
-            feed = getattr(op, attr, None)
-            if feed is not None and getattr(feed, "replayable", True) is False:
-                emit(
-                    f"source {t.name!r} ({type(feed).__name__}) is not "
-                    "replayable: after a restart-from-checkpoint its "
-                    "stream cannot be rewound, so delivery through this "
-                    "job is at-least-once (or lossy for in-flight "
-                    "records) regardless of sink transactionality — "
-                    "front it with a durable FileSplitSource-backed "
-                    "write-ahead log for end-to-end exactly-once",
-                    node=t.name,
-                )
-                break
+# NOTE: the ``exactly-once-boundary`` lint that lived here through
+# PR 19 is now the dataflow pass in analysis/statecheck.py — same rule
+# id and same WARN at the non-replayable source, plus delivery-
+# guarantee propagation along every edge and a path-provenance ERROR
+# when at-least-once provenance reaches a sink declaring
+# ``idempotent = False``.  Registered via the bottom import below.
 
 
 @rule("cohort-telemetry", Severity.WARN)
@@ -1038,3 +1006,11 @@ def _recompile_churn(ctx: AnalysisContext, emit: Emit) -> None:
 from flink_tensorflow_tpu.analysis import shardcheck as _shardcheck  # noqa: E402
 
 _shardcheck._register_rules()
+
+# statecheck family (analysis/statecheck.py): hidden-state / train-state /
+# rescale-safety / RNG-stream verdicts plus the promoted exactly-once
+# dataflow pass register the same way.
+
+from flink_tensorflow_tpu.analysis import statecheck as _statecheck  # noqa: E402
+
+_statecheck._register_rules()

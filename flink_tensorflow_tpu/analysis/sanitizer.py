@@ -175,10 +175,21 @@ def _classify_chain(
 
 
 #: Interprocedural depth: helpers called DIRECTLY from a scanned user
-#: function are scanned too (one level); their callees are not.  One
-#: level catches the ubiquitous "map fn delegates to a module helper"
-#: split without turning the scanner into a whole-program analysis.
-_MAX_CALL_DEPTH = 1
+#: function are scanned, and so are THEIR helpers (two levels by
+#: default — ``outer -> helper -> helper2`` provenance chains); deeper
+#: callees are not.  Two levels cover the ubiquitous "map fn delegates
+#: to a module helper which delegates to a shared util" split without
+#: turning the scanner into a whole-program analysis; pass
+#: ``max_depth=`` to :func:`scan_code` to tune it per call.
+_MAX_CALL_DEPTH = 2
+
+#: Memoized per-code local scans: id(code) -> (code, base, findings,
+#: helpers).  The same helper reached from many operators (or many
+#: outer functions) is disassembled ONCE; callers re-root the cached
+#: findings' ``where`` onto their own provenance chain.  The value
+#: holds a strong reference to the code object so its id cannot be
+#: recycled while the cache entry lives.
+_SCAN_CACHE: typing.Dict[int, tuple] = {}
 
 
 def _helper_fn(
@@ -197,26 +208,22 @@ def _helper_fn(
     return fn
 
 
-def scan_code(
-    code: types.CodeType,
-    globals_ns: typing.Optional[dict] = None,
-    where: typing.Optional[str] = None,
-    *,
-    _depth: int = 0,
-    _seen: typing.Optional[typing.Set[int]] = None,
-) -> typing.List[PurityFinding]:
-    """Purity findings for one code object (nested code included), plus
-    — one direct-call level deep — every user-defined helper it names
-    (scanned with the same matrix, attributed ``outer -> helper``).
-    Recursion is cut by a seen-set over code objects, stdlib/framework
-    callees by the user-code filter."""
+def _scan_local(
+    code: types.CodeType, globals_ns: typing.Optional[dict],
+) -> typing.Tuple[str, typing.List[PurityFinding],
+                  typing.List[types.FunctionType]]:
+    """One code object's OWN findings (nested code included) plus the
+    user-defined helpers it names — no recursion into them.  Memoized:
+    findings carry a base-relative ``where`` (rooted at the code's own
+    qualname) which :func:`scan_code` re-roots per caller."""
+    cached = _SCAN_CACHE.get(id(code))
+    if cached is not None and cached[0] is code:
+        return cached[1], cached[2], cached[3]
+    base = getattr(code, "co_qualname", code.co_name)
     findings: typing.List[PurityFinding] = []
-    seen = _seen if _seen is not None else set()
-    seen.add(id(code))
     helpers: typing.List[types.FunctionType] = []
-    top = where or getattr(code, "co_qualname", code.co_name)
     for co in _iter_code_objects(code):
-        qual = top if co is code else f"{top}.<{co.co_name}>"
+        qual = base if co is code else f"{base}.<{co.co_name}>"
         chain: typing.List[str] = []
         chain_line: typing.Optional[int] = None
         line: typing.Optional[int] = None
@@ -240,14 +247,44 @@ def scan_code(
                         where=qual, line=line,
                     ))
         _flush(chain, chain_line, globals_ns, qual, findings, helpers)
-    if _depth < _MAX_CALL_DEPTH:
+    _SCAN_CACHE[id(code)] = (code, base, findings, helpers)
+    return base, findings, helpers
+
+
+def scan_code(
+    code: types.CodeType,
+    globals_ns: typing.Optional[dict] = None,
+    where: typing.Optional[str] = None,
+    *,
+    max_depth: typing.Optional[int] = None,
+    _depth: int = 0,
+    _seen: typing.Optional[typing.Set[int]] = None,
+) -> typing.List[PurityFinding]:
+    """Purity findings for one code object (nested code included), plus
+    — ``max_depth`` call levels deep (default :data:`_MAX_CALL_DEPTH`)
+    — every user-defined helper it names, scanned with the same matrix
+    and attributed along the full ``outer -> helper -> helper2``
+    provenance chain.  Recursion is cut by a seen-set over code objects
+    (the cycle guard), stdlib/framework callees by the user-code
+    filter; per-code disassembly is memoized in :data:`_SCAN_CACHE`."""
+    depth_cap = _MAX_CALL_DEPTH if max_depth is None else max_depth
+    seen = _seen if _seen is not None else set()
+    seen.add(id(code))
+    top = where or getattr(code, "co_qualname", code.co_name)
+    base, local, helpers = _scan_local(code, globals_ns)
+    if top == base:
+        findings = list(local)
+    else:  # re-root the cached base-relative provenance onto this chain
+        findings = [dataclasses.replace(f, where=top + f.where[len(base):])
+                    for f in local]
+    if _depth < depth_cap:
         for helper in helpers:
             if id(helper.__code__) in seen:
                 continue  # recursion / already-scanned helper
             findings.extend(scan_code(
                 helper.__code__, helper.__globals__,
                 where=f"{top} -> {helper.__qualname__}",
-                _depth=_depth + 1, _seen=seen,
+                max_depth=depth_cap, _depth=_depth + 1, _seen=seen,
             ))
     return findings
 

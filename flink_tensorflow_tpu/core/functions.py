@@ -225,6 +225,14 @@ class SourceFunction(RichFunction, abc.ABC):
 
 
 class SinkFunction(RichFunction, abc.ABC):
+    #: Delivery-guarantee declaration read by the statecheck
+    #: exactly-once dataflow pass: ``True`` — replayed duplicates
+    #: collapse (transactional/upsert sinks); ``False`` — every
+    #: replayed record repeats the side effect (ERROR when at-least-
+    #: once provenance reaches it); ``None`` (default) — unknown, the
+    #: analyzer stays quiet.
+    idempotent: typing.Optional[bool] = None
+
     @abc.abstractmethod
     def invoke(self, value: typing.Any) -> None: ...
 

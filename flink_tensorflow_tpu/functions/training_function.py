@@ -103,6 +103,12 @@ class OnlineTrainFunction(fn.ProcessFunction):
 
     #: Plan-analyzer marker: records feed a jitted train step.
     is_jit_boundary = True
+    #: The jitted step does NOT donate the TrainState (the pipelined
+    #: dispatch keeps the previous state live until its metrics are
+    #: fetched) — statecheck's train-state audit turns this into the
+    #: 2x-HBM WARN once the abstract TrainState crosses the donation
+    #: threshold.
+    donates_train_state = False
 
     def __init__(
         self,
@@ -396,6 +402,10 @@ class DPTrainWindowFunction(fn.WindowFunction):
     #: checks global_batch against the mesh's data axis at plan time).
     is_jit_boundary = True
     is_gang = True
+    #: make_dp_train_step donates the TrainState through the jitted
+    #: step (donate_argnums=(0,)): params + moments update in place,
+    #: no double-buffering — statecheck's train-state audit reads this.
+    donates_train_state = True
 
     def __init__(
         self,

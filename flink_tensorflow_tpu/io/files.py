@@ -72,6 +72,11 @@ class RecordFileSource(fn.SourceFunction):
     concatenated files (same striding contract as CollectionSource, so
     offsets restore exactly)."""
 
+    #: Frame files on durable storage ARE the write-ahead log the
+    #: exactly-once boundary pattern prescribes: reading through this
+    #: source upgrades a non-replayable feed to exactly-once.
+    wal_fronted = True
+
     def __init__(self, paths: typing.Union[str, typing.Sequence[str]]):
         self.paths = [paths] if isinstance(paths, str) else list(paths)
         self._subtask = 0
@@ -105,6 +110,12 @@ class ExactlyOnceRecordFileSink(fn.SinkFunction):
     ``.inprogress``.  Use :func:`committed_files` /
     :func:`read_committed` to consume only exactly-once output.
     """
+
+    #: Two-phase commit: replayed records land in a transaction that
+    #: supersedes the aborted one, so duplicates collapse — at-least-
+    #: once provenance arriving here is absorbed (statecheck INFO, not
+    #: ERROR).
+    idempotent = True
 
     def __init__(self, directory: str):
         self.directory = directory

@@ -92,6 +92,12 @@ class RemoteSink(fn.SinkFunction):
     """Ships records (TensorValue) to a RemoteSource over TCP, coalesced
     into multi-record bursts with a columnar fast path."""
 
+    #: Frames replayed after a restore are SENT AGAIN down the wire and
+    #: the peer cannot tell them from fresh ones — the statecheck
+    #: exactly-once dataflow pass ERRORs when at-least-once provenance
+    #: terminates here.
+    idempotent = False
+
     def __init__(self, host: str, port: int, *, connect_timeout_s: float = 30.0,
                  wire_dtype: typing.Optional[str] = None,
                  flush_bytes: typing.Optional[int] = None,

@@ -101,6 +101,15 @@ class ServingConfig:
             return self.hbm_pages
         return self.max_active_seqs * (self.capacity // self.page_tokens)
 
+    def page_partition(self, key_groups: int) -> typing.Tuple[int, int]:
+        """``(pages_per_group, remainder)`` when the HBM page pool is
+        dealt out along ``key_groups`` key groups.  A zero remainder
+        means a p→p′ rescale hands whole key-group page sets between
+        subtasks (pages move, sessions don't re-prefill); a nonzero one
+        is the ``statecheck-page-keygroup`` WARN."""
+        pages = self.resolved_hbm_pages()
+        return pages // key_groups, pages % key_groups
+
     def resolved_prompt_buckets(self) -> typing.Tuple[int, ...]:
         return self.prompt_buckets or _pow2_buckets(self.capacity)
 

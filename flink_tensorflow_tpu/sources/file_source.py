@@ -58,6 +58,10 @@ class FileSplitSource(SplitSource):
     a single huge file still parallelizes.
     """
 
+    #: THE write-ahead-log ingest path the exactly-once boundary lint
+    #: prescribes: durable frame files, split offsets in snapshots.
+    wal_fronted = True
+
     def __init__(self, paths: typing.Union[str, typing.Sequence[str]], *,
                  records_per_split: typing.Optional[int] = None,
                  schema=None):

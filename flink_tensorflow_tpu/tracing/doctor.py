@@ -260,6 +260,26 @@ def shardcheck_findings(report: typing.Optional[dict]
     return out
 
 
+def statecheck_findings(report: typing.Optional[dict]
+                        ) -> typing.List[str]:
+    """Static statecheck verdicts (``flink-tpu-statecheck --out``)
+    folded into doctor findings.  ERROR findings are plan-level proof
+    (hidden state the snapshot never sees, an at-least-once path into a
+    non-idempotent sink, a moment sharded away from its param) and rank
+    with the shardcheck verdicts; WARNs ride along as exact-resume
+    context for the statistical signals."""
+    if not report:
+        return []
+    out: typing.List[str] = []
+    for f in report.get("findings", ()):
+        if f.get("severity") == "INFO":
+            continue
+        where = f.get("edge") or f.get("node") or "plan"
+        out.append(f"statecheck {f.get('severity', '?')} "
+                   f"[{f.get('rule', '?')}] {where}: {f.get('message', '')}")
+    return out
+
+
 def roofline_findings(report: typing.Optional[dict]) -> typing.List[str]:
     """Roofline drift verdicts (``flink-tpu-roofline --out``) folded
     into doctor findings: measured-vs-predicted divergence and
@@ -290,6 +310,7 @@ def diagnose(
     decision: typing.Optional[dict] = None,
     sanitizer_report: typing.Optional[dict] = None,
     shardcheck_report: typing.Optional[dict] = None,
+    statecheck_report: typing.Optional[dict] = None,
     roofline_report: typing.Optional[dict] = None,
     channel_capacity: int = 1024,
     top: int = 3,
@@ -310,9 +331,11 @@ def diagnose(
     actions = supervisor_actions(flight_docs, decision)
     san_findings = sanitizer_findings(sanitizer_report)
     shard_findings = shardcheck_findings(shardcheck_report)
+    state_findings = statecheck_findings(statecheck_report)
     roof_findings = roofline_findings(roofline_report)
 
     findings: typing.List[str] = (list(san_findings) + list(shard_findings)
+                                  + list(state_findings)
                                   + list(roof_findings))
     named: typing.Set[str] = set()
     for rank, b in enumerate(bottlenecks[:top], start=1):
@@ -372,6 +395,7 @@ def diagnose(
         "actions": actions,
         "sanitizer": san_findings,
         "shardcheck": shard_findings,
+        "statecheck": state_findings,
         "roofline": roof_findings,
     }
 
@@ -427,6 +451,11 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                              "(flink-tpu-shardcheck --out): plan-level "
                              "layout/donation/HBM verdicts fold in after "
                              "protocol violations")
+    parser.add_argument("--statecheck", default=None, metavar="REPORT.json",
+                        help="static statecheck report "
+                             "(flink-tpu-statecheck --out): exact-resume/"
+                             "RNG-stream/rescale-safety verdicts fold in "
+                             "alongside the shardcheck ones")
     parser.add_argument("--roofline", default=None, metavar="REPORT.json",
                         help="roofline report (flink-tpu-roofline --out): "
                              "MFU/headroom context and predicted-vs-"
@@ -448,6 +477,7 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     flight_docs: typing.List[dict] = []
     sanitizer_report: typing.Optional[dict] = None
     shardcheck_report: typing.Optional[dict] = None
+    statecheck_report: typing.Optional[dict] = None
     roofline_report: typing.Optional[dict] = None
     loaded = 0
     try:
@@ -486,6 +516,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                 raise ValueError(f"{args.shardcheck}: not a shardcheck "
                                  "report")
             loaded += 1
+        if args.statecheck:
+            with open(args.statecheck) as f:
+                statecheck_report = json.load(f)
+            if not isinstance(statecheck_report, dict):
+                raise ValueError(f"{args.statecheck}: not a statecheck "
+                                 "report")
+            loaded += 1
         if args.roofline:
             with open(args.roofline) as f:
                 roofline_report = json.load(f)
@@ -510,13 +547,14 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     if not loaded:
         parser.error("provide at least one of --snapshot / --flight / "
                      "--trace / --decision / --sanitizer / --shardcheck / "
-                     "--roofline")
+                     "--statecheck / --roofline")
     events.sort(key=lambda ev: ev[3])
 
     report = diagnose(
         snapshot, events=events, flight_docs=flight_docs,
         decision=decision, sanitizer_report=sanitizer_report,
         shardcheck_report=shardcheck_report,
+        statecheck_report=statecheck_report,
         roofline_report=roofline_report,
         channel_capacity=args.channel_capacity,
         top=args.top,

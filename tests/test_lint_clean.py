@@ -115,6 +115,33 @@ def test_examples_have_zero_shardcheck_errors(pipeline):
     assert errors == [], format_diagnostics(diags)
 
 
+@pytest.mark.parametrize("pipeline", EXAMPLES + [
+    "examples/split_source_pipeline.py",
+    "examples/llm_serving_pipeline.py",
+])
+def test_examples_have_zero_statecheck_errors(pipeline):
+    """Tier-1 statecheck gate (PR 20): no example plan may carry an
+    exact-resume ERROR — hidden state outside snapshots, a moment
+    sharded away from its param, a constant seed on a keyed record
+    path, or an at-least-once path terminating in a non-idempotent
+    sink are all resume/rescale failures a job only discovers at the
+    restore nobody tests.  WARNs (donation advice, rescale caveats)
+    are advisory and allowed."""
+    from flink_tensorflow_tpu.analysis import (
+        Severity,
+        analyze,
+        capture_pipeline_file,
+        format_diagnostics,
+    )
+
+    env = capture_pipeline_file(str(REPO / pipeline))
+    diags = [d for d in analyze(env.graph, config=env.config)
+             if d.rule.startswith("statecheck")
+             or d.rule == "exactly-once-boundary"]
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    assert errors == [], format_diagnostics(diags)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("pipeline", EXAMPLES)
 def test_examples_inspect_clean(pipeline):
